@@ -1,0 +1,52 @@
+"""dalle_pytorch_tpu — a TPU-native (JAX/XLA/Pallas/pjit) text-to-image framework.
+
+Re-implements, TPU-first, the full capability surface of the reference
+DALLE-pytorch (HURU-School/DALLE-pytorch, fork of lucidrains/DALLE-pytorch
+v0.0.36):
+
+  * ``DiscreteVAE`` — conv encoder/decoder with a Gumbel-softmax discrete
+    codebook (reference: dalle_pytorch/dalle_pytorch.py:65-157).
+  * ``DALLE``       — joint text+image autoregressive transformer with
+    per-position vocab masking, reversible blocks and block-sparse attention
+    (reference: dalle_pytorch/dalle_pytorch.py:241-407).
+  * ``CLIP``        — dual-encoder contrastive reranker
+    (reference: dalle_pytorch/dalle_pytorch.py:161-237).
+
+Unlike the reference — which is a torch/CUDA design — everything here is a
+pure function over pytree parameters: jit/pjit-compiled, scan-over-layers,
+Pallas kernels for attention, ``jax.sharding`` for data/tensor/sequence
+parallelism, and stateless PRNG keys instead of device RNG snapshots.
+
+The public API mirrors the reference's three exported names
+(reference: dalle_pytorch/__init__.py:1) plus the functional layer beneath.
+"""
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DALLE",
+    "CLIP",
+    "DiscreteVAE",
+    "DALLEConfig",
+    "CLIPConfig",
+    "VAEConfig",
+]
+
+_EXPORTS = {
+    "DiscreteVAE": ("dalle_pytorch_tpu.models.vae", "DiscreteVAE"),
+    "VAEConfig": ("dalle_pytorch_tpu.models.vae", "VAEConfig"),
+    "DALLE": ("dalle_pytorch_tpu.models.dalle", "DALLE"),
+    "DALLEConfig": ("dalle_pytorch_tpu.models.dalle", "DALLEConfig"),
+    "CLIP": ("dalle_pytorch_tpu.models.clip", "CLIP"),
+    "CLIPConfig": ("dalle_pytorch_tpu.models.clip", "CLIPConfig"),
+}
+
+
+def __getattr__(name):
+    # Lazy exports keep `import dalle_pytorch_tpu.ops` free of model imports
+    # (and of jax compilation work) until a model class is actually needed.
+    if name in _EXPORTS:
+        import importlib
+        module, attr = _EXPORTS[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

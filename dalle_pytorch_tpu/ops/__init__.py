@@ -1,0 +1,8 @@
+"""Functional neural-net primitives and kernels (TPU-first).
+
+This package is the L1/L3 layer of the framework: parameter init/apply pairs
+for the primitive ops (ops.core), attention in several implementations
+(ops.attention: XLA einsum reference, Pallas flash, Pallas block-sparse),
+and the transformer stack (ops.transformer) executed either sequentially via
+``lax.scan`` or reversibly via a ``jax.custom_vjp`` engine (ops.reversible).
+"""

@@ -1,0 +1,164 @@
+"""Primitive init/apply ops: linear, layernorm, embedding, conv.
+
+Parameters are plain nested dicts of ``jnp.ndarray`` (pytrees). Every op is a
+pure function ``apply(params, x, ...)`` so it composes with ``jit``, ``scan``,
+``vmap``, ``custom_vjp`` and ``shard_map`` without a module system in the way.
+
+Initialisation follows the reference's torch defaults in distribution family
+(uniform ±1/sqrt(fan_in) for linear/conv, N(0,1) for embeddings — see
+torch.nn.Linear/Conv2d/Embedding resets) so training dynamics are comparable,
+though bitwise weight parity with torch is a non-goal.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# initializers
+# ---------------------------------------------------------------------------
+
+def uniform_fan_in(key: Array, shape: Sequence[int], fan_in: int,
+                   dtype=jnp.float32) -> Array:
+    """torch-style kaiming-uniform(a=sqrt(5)) ≡ U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+    bound = 1.0 / math.sqrt(max(fan_in, 1))
+    return jax.random.uniform(key, tuple(shape), dtype, -bound, bound)
+
+
+def normal_init(key: Array, shape: Sequence[int], stddev: float = 1.0,
+                dtype=jnp.float32) -> Array:
+    return jax.random.normal(key, tuple(shape), dtype) * stddev
+
+
+# ---------------------------------------------------------------------------
+# linear
+# ---------------------------------------------------------------------------
+
+def linear_init(key: Array, in_dim: int, out_dim: int, *, bias: bool = True,
+                dtype=jnp.float32) -> dict:
+    kw, kb = jax.random.split(key)
+    params = {"w": uniform_fan_in(kw, (in_dim, out_dim), in_dim, dtype)}
+    if bias:
+        params["b"] = uniform_fan_in(kb, (out_dim,), in_dim, dtype)
+    return params
+
+
+def linear(params: dict, x: Array) -> Array:
+    """y = x @ w (+ b). Keeps the contraction in the input dtype so bf16
+    activations hit the MXU; accumulation dtype is left to XLA (f32 on TPU)."""
+    y = jnp.dot(x, params["w"].astype(x.dtype))
+    if "b" in params:
+        y = y + params["b"].astype(x.dtype)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# layer norm
+# ---------------------------------------------------------------------------
+
+def layernorm_init(dim: int, dtype=jnp.float32) -> dict:
+    return {"g": jnp.ones((dim,), dtype), "b": jnp.zeros((dim,), dtype)}
+
+
+def layernorm(params: dict, x: Array, *, eps: float = 1e-5) -> Array:
+    # Normalise in f32 for numerical stability, cast back to input dtype.
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    y = y * params["g"].astype(jnp.float32) + params["b"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# embedding
+# ---------------------------------------------------------------------------
+
+def embedding_init(key: Array, num_embeddings: int, dim: int,
+                   dtype=jnp.float32) -> dict:
+    return {"w": normal_init(key, (num_embeddings, dim), 1.0, dtype)}
+
+
+def embedding(params: dict, ids: Array) -> Array:
+    return jnp.take(params["w"], ids, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# conv2d (NHWC internally — the TPU-native layout)
+# ---------------------------------------------------------------------------
+
+def conv2d_init(key: Array, in_ch: int, out_ch: int, kernel: int, *,
+                dtype=jnp.float32) -> dict:
+    kw, kb = jax.random.split(key)
+    fan_in = in_ch * kernel * kernel
+    return {
+        "w": uniform_fan_in(kw, (kernel, kernel, in_ch, out_ch), fan_in, dtype),
+        "b": uniform_fan_in(kb, (out_ch,), fan_in, dtype),
+    }
+
+
+def conv2d(params: dict, x: Array, *, stride: int = 1, padding: int = 0) -> Array:
+    """2-D convolution over NHWC input with an HWIO kernel."""
+    w = params["w"].astype(x.dtype)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NHWC", "HWIO", "NHWC"))
+    y = lax.conv_general_dilated(
+        x, w,
+        window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        dimension_numbers=dn,
+    )
+    return y + params["b"].astype(x.dtype)
+
+
+def conv2d_transpose(params: dict, x: Array, *, stride: int = 2,
+                     padding: int = 1) -> Array:
+    """Transposed conv matching torch ConvTranspose2d(k, stride, padding):
+    implemented as input-dilated convolution with a spatially flipped kernel
+    (out spatial = in*stride for k=4, s=2, p=1 — the dVAE upsample shape,
+    reference dalle_pytorch/dalle_pytorch.py:105)."""
+    w = params["w"].astype(x.dtype)  # (kh, kw, in, out)
+    k = w.shape[0]
+    w_flipped = w[::-1, ::-1, :, :]
+    pad = k - 1 - padding
+    dn = lax.conv_dimension_numbers(x.shape, w_flipped.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    y = lax.conv_general_dilated(
+        x, w_flipped,
+        window_strides=(1, 1),
+        padding=((pad, pad), (pad, pad)),
+        lhs_dilation=(stride, stride),
+        dimension_numbers=dn,
+    )
+    return y + params["b"].astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# activations / misc
+# ---------------------------------------------------------------------------
+
+def gelu(x: Array) -> Array:
+    """Exact (erf) GELU, matching torch F.gelu default used by the reference
+    GEGLU (reference dalle_pytorch/transformer.py:36)."""
+    return jax.nn.gelu(x, approximate=False)
+
+
+def dropout(key: Optional[Array], x: Array, rate: float, train: bool) -> Array:
+    if not train or rate == 0.0 or key is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def neg_inf(dtype) -> Array:
+    """The reference's mask fill value: -finfo(dtype).max
+    (reference dalle_pytorch/transformer.py:72)."""
+    return jnp.asarray(-jnp.finfo(jnp.dtype(dtype)).max, dtype)

@@ -1,0 +1,103 @@
+"""Block-sparse attention layout + XLA reference implementation.
+
+Replicates the semantics the reference gets from DeepSpeed's
+``SparseSelfAttention(VariableSparsityConfig(num_heads, block=16,
+attention='unidirectional'))`` (reference dalle_pytorch/transformer.py:91-135):
+
+  * the sequence is tiled into blocks of ``block`` tokens (16 in the
+    reference);
+  * queries attend within their **local window** of ``num_local_blocks``
+    consecutive blocks (VariableSparsityConfig default: 4 blocks — windows are
+    the non-overlapping groups [0..3], [4..7], ...);
+  * every query additionally attends to the **global blocks**
+    (default: block 0);
+  * causal masking on top for unidirectional attention;
+  * inputs are padded to a block multiple, pad **keys** are masked
+    (key_padding_mask — unlike the dense path, pad queries are NOT masked,
+    reference transformer.py:120-122), and the output is sliced back
+    (reference transformer.py:109-135).
+
+``sparse_attention_ref`` is the numerics oracle: dense softmax restricted to
+the layout. The Pallas kernel (ops.block_sparse) must agree with it; the
+transformer picks between them with ``sparse_impl``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_pytorch_tpu.ops import core
+
+Array = jax.Array
+
+
+@functools.lru_cache(maxsize=32)
+def variable_sparsity_layout(num_blocks: int, *, num_local_blocks: int = 4,
+                             global_blocks: Tuple[int, ...] = (0,),
+                             causal: bool = True) -> np.ndarray:
+    """(num_blocks, num_blocks) bool — True where block (q, k) is attended."""
+    ib = np.arange(num_blocks)[:, None]
+    jb = np.arange(num_blocks)[None, :]
+    same_window = (ib // num_local_blocks) == (jb // num_local_blocks)
+    layout = same_window
+    for g in global_blocks:
+        layout = layout | (jb == g)
+    if causal:
+        layout = layout & (jb <= ib)
+    return layout
+
+
+def token_layout_mask(seq_len: int, block: int = 16, *,
+                      num_local_blocks: int = 4,
+                      global_blocks: Tuple[int, ...] = (0,),
+                      causal: bool = True) -> np.ndarray:
+    """Expand the block layout to a (seq_len, seq_len) token mask (True=keep).
+
+    The causal constraint here is block-level only; the token-level strict
+    triangle is applied separately (matching DeepSpeed, which combines a block
+    layout with an additive token-level causal mask,
+    reference transformer.py:124-130).
+    """
+    assert seq_len % block == 0
+    nb = seq_len // block
+    layout = variable_sparsity_layout(
+        nb, num_local_blocks=num_local_blocks, global_blocks=global_blocks,
+        causal=causal)
+    return np.repeat(np.repeat(layout, block, axis=0), block, axis=1)
+
+
+def sparse_attention_ref(q: Array, k: Array, v: Array, *, scale: float,
+                         causal: bool, block: int = 16,
+                         mask: Optional[Array] = None,
+                         num_local_blocks: int = 4,
+                         global_blocks: Tuple[int, ...] = (0,)) -> Array:
+    """Dense-math oracle for block-sparse attention.
+
+    q, k, v: (b, h, n, d). ``mask``: (b, n) key-padding mask (True = keep).
+    Assumes n is a block multiple (the transformer pads beforehand, as the
+    reference does at transformer.py:112-115).
+    """
+    b, h, n, d = q.shape
+    dots = jnp.einsum("bhid,bhjd->bhij", q, k) * scale
+    fill = core.neg_inf(dots.dtype)
+
+    layout = jnp.asarray(token_layout_mask(
+        n, block, num_local_blocks=num_local_blocks,
+        global_blocks=global_blocks, causal=causal))
+    allowed = layout[None, None, :, :]
+
+    if causal:
+        tri = jnp.tril(jnp.ones((n, n), bool))
+        allowed = allowed & tri[None, None, :, :]
+
+    if mask is not None:
+        allowed = allowed & mask[:, None, None, :]  # key padding only
+
+    dots = jnp.where(allowed, dots, fill)
+    attn = jax.nn.softmax(dots, axis=-1)
+    return jnp.einsum("bhij,bhjd->bhid", attn, v)

@@ -1,0 +1,207 @@
+"""Transformer stack: PreNorm(attn) + PreNorm(GEGLU-FF) pairs.
+
+Mirrors the reference ``Transformer`` (reference dalle_pytorch/
+transformer.py:137-172) — per layer a residual attention block then a
+residual feed-forward block, with the pad ``mask`` routed only into attention
+(reference reversible.py:8-17, transformer.py:166-167) — but executes the
+stack the TPU way:
+
+  * layer parameters are **stacked** on a leading depth axis and the stack
+    runs as one ``lax.scan`` — one compiled layer body regardless of depth,
+    which is what keeps XLA compile time and code size flat at depth 64;
+  * mixed dense/sparse patterns (e.g. the reference's
+    ``sparse_attn=(True, False)*32``) run in the same scan with a
+    ``lax.cond`` on a per-layer flag;
+  * ``reversible=True`` swaps the scan for the O(1)-activation-memory
+    ``custom_vjp`` engine in ops.reversible (reference reversible.py:54-157);
+  * ``remat='full'`` applies ``jax.checkpoint`` to the scanned body —
+    the XLA-native activation/compute trade.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dalle_pytorch_tpu.ops import attention as attn_ops
+from dalle_pytorch_tpu.ops import core, sparse
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    dim: int
+    depth: int
+    seq_len: int
+    heads: int = 8
+    dim_head: int = 64
+    ff_mult: int = 4
+    causal: bool = True
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    reversible: bool = False
+    # per-layer dense/sparse selection; bool or tuple of bools of len depth
+    # (reference transformer.py:155-158 cast_tuple)
+    sparse_attn: Union[bool, Tuple[bool, ...]] = False
+    sparse_block: int = 16
+    attn_impl: str = "xla"      # 'xla' | 'flash'
+    sparse_impl: str = "ref"    # 'ref' | 'pallas'
+    # reference uses dim**-0.5 (transformer.py:57); 'head' gives dim_head**-0.5
+    scale_mode: str = "dim"
+    remat: str = "none"          # 'none' | 'full'
+
+    @property
+    def sparse_pattern(self) -> Tuple[bool, ...]:
+        if isinstance(self.sparse_attn, bool):
+            return (self.sparse_attn,) * self.depth
+        assert len(self.sparse_attn) == self.depth
+        return tuple(self.sparse_attn)
+
+    @property
+    def scale(self) -> float:
+        base = self.dim if self.scale_mode == "dim" else self.dim_head
+        return base ** -0.5
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def layer_init(key: Array, cfg: TransformerConfig, dtype=jnp.float32) -> dict:
+    k_attn, k_ff1, k_ff2 = jax.random.split(key, 3)
+    hidden = cfg.dim * cfg.ff_mult
+    return {
+        "attn": {
+            "ln": core.layernorm_init(cfg.dim, dtype),
+            **attn_ops.attention_init(k_attn, cfg.dim, cfg.heads, cfg.dim_head,
+                                      dtype),
+        },
+        "ff": {
+            "ln": core.layernorm_init(cfg.dim, dtype),
+            "w1": core.linear_init(k_ff1, cfg.dim, hidden * 2, dtype=dtype),
+            "w2": core.linear_init(k_ff2, hidden, cfg.dim, dtype=dtype),
+        },
+    }
+
+
+def transformer_init(key: Array, cfg: TransformerConfig,
+                     dtype=jnp.float32) -> dict:
+    keys = jax.random.split(key, cfg.depth)
+    return jax.vmap(lambda k: layer_init(k, cfg, dtype))(keys)
+
+
+# ---------------------------------------------------------------------------
+# the two residual branches (f = attention, g = feed-forward)
+# ---------------------------------------------------------------------------
+
+def attn_branch(layer_params: dict, x: Array, mask: Optional[Array],
+                cfg: TransformerConfig, is_sparse, key: Optional[Array],
+                train: bool) -> Array:
+    """PreNorm attention. ``is_sparse`` may be a traced bool scalar — when the
+    pattern is mixed, both branches are compiled once and selected per layer
+    with lax.cond."""
+    p = layer_params["attn"]
+    h = core.layernorm(p["ln"], x)
+
+    dense_kwargs = dict(heads=cfg.heads, dim_head=cfg.dim_head,
+                        scale=cfg.scale, causal=cfg.causal, mask=mask,
+                        dropout_rate=cfg.attn_dropout, dropout_key=key,
+                        train=train, impl=cfg.attn_impl)
+
+    pattern = cfg.sparse_pattern
+    if not any(pattern):
+        return attn_ops.attention_apply(p, h, **dense_kwargs)
+
+    def dense_fn(h):
+        return attn_ops.attention_apply(p, h, **dense_kwargs)
+
+    def sparse_fn(h):
+        # Pad to a block multiple, mask pad keys, slice back — the reference's
+        # SparseAttention padding contract (transformer.py:109-135).
+        n = h.shape[1]
+        block = cfg.sparse_block
+        pad = (-n) % block
+        kp_mask = mask
+        if pad:
+            h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+            if kp_mask is None:
+                kp_mask = jnp.ones((h.shape[0], n), bool)
+            kp_mask = jnp.pad(kp_mask, ((0, 0), (0, pad)))
+        q, k, v = attn_ops.qkv_project(p, h, cfg.heads)
+        if cfg.sparse_impl == "pallas":
+            from dalle_pytorch_tpu.ops.block_sparse import block_sparse_attention
+            out = block_sparse_attention(q, k, v, scale=cfg.scale,
+                                         causal=cfg.causal, mask=kp_mask,
+                                         block=block)
+        else:
+            out = sparse.sparse_attention_ref(q, k, v, scale=cfg.scale,
+                                             causal=cfg.causal, mask=kp_mask,
+                                             block=block)
+        out = attn_ops.merge_heads(out)[:, :n]
+        out = core.linear(p["out"], out)
+        return core.dropout(key, out, cfg.attn_dropout, train)
+
+    if all(pattern):
+        return sparse_fn(h)
+    return lax.cond(is_sparse, sparse_fn, dense_fn, h)
+
+
+def ff_branch(layer_params: dict, x: Array, cfg: TransformerConfig,
+              key: Optional[Array], train: bool) -> Array:
+    """PreNorm GEGLU feed-forward (reference transformer.py:33-49)."""
+    p = layer_params["ff"]
+    h = core.layernorm(p["ln"], x)
+    h = core.linear(p["w1"], h)
+    h, gates = jnp.split(h, 2, axis=-1)
+    h = h * core.gelu(gates)
+    h = core.dropout(key, h, cfg.ff_dropout, train)
+    return core.linear(p["w2"], h)
+
+
+# ---------------------------------------------------------------------------
+# apply
+# ---------------------------------------------------------------------------
+
+def _layer_keys(rng: Optional[Array], depth: int) -> Array:
+    if rng is None:
+        # Only reached when dropout is statically off (apply validates) —
+        # the keys are dead values threaded through scan for pytree symmetry.
+        rng = jax.random.PRNGKey(0)
+    return jax.random.split(rng, depth * 2).reshape(depth, 2, 2)
+
+
+def transformer_apply(params: dict, x: Array, *, cfg: TransformerConfig,
+                      mask: Optional[Array] = None,
+                      rng: Optional[Array] = None,
+                      train: bool = False) -> Array:
+    """Run the stack. x: (b, n, dim); mask: (b, n) bool (True = keep)."""
+    if train and rng is None and (cfg.attn_dropout > 0 or cfg.ff_dropout > 0):
+        raise ValueError(
+            "transformer_apply(train=True) with nonzero dropout requires an "
+            "explicit `rng` key — JAX has no global RNG state to fall back on")
+
+    if cfg.reversible:
+        from dalle_pytorch_tpu.ops.reversible import reversible_apply
+        return reversible_apply(params, x, cfg=cfg, mask=mask, rng=rng,
+                                train=train)
+
+    keys = _layer_keys(rng, cfg.depth)
+    sparse_flags = jnp.asarray(cfg.sparse_pattern)
+
+    def body(carry, xs):
+        lp, lkeys, is_sparse = xs
+        h = carry
+        h = h + attn_branch(lp, h, mask, cfg, is_sparse, lkeys[0], train)
+        h = h + ff_branch(lp, h, cfg, lkeys[1], train)
+        return h, None
+
+    if cfg.remat == "full":
+        body = jax.checkpoint(body)
+
+    out, _ = lax.scan(body, x, (params, keys, sparse_flags))
+    return out

@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device CPU platform BEFORE jax initialises.
+
+Multi-chip behaviour (DP/TP/SP meshes, collectives) is tested on a virtual
+8-device CPU mesh — the standard JAX substitute for a pod (SURVEY.md §4e).
+Must run before any jax import in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")

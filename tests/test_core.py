@@ -1,0 +1,80 @@
+"""Unit tests for ops.core primitives."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.ops import core
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)
+
+
+def test_linear_shapes_and_bias(key):
+    p = core.linear_init(key, 8, 16)
+    x = jnp.ones((2, 3, 8))
+    y = core.linear(p, x)
+    assert y.shape == (2, 3, 16)
+    p2 = core.linear_init(key, 8, 16, bias=False)
+    assert "b" not in p2
+
+
+def test_layernorm_normalises(key):
+    p = core.layernorm_init(6)
+    x = jax.random.normal(key, (4, 6)) * 5 + 3
+    y = core.layernorm(p, x)
+    np.testing.assert_allclose(np.mean(y, -1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.std(y, -1), 1.0, atol=1e-2)
+
+
+def test_embedding_lookup(key):
+    p = core.embedding_init(key, 10, 4)
+    ids = jnp.array([[1, 2], [3, 4]])
+    y = core.embedding(p, ids)
+    assert y.shape == (2, 2, 4)
+    np.testing.assert_array_equal(y[0, 0], p["w"][1])
+
+
+def test_conv2d_stride2_downsamples(key):
+    p = core.conv2d_init(key, 3, 8, 4)
+    x = jnp.ones((2, 16, 16, 3))
+    y = core.conv2d(p, x, stride=2, padding=1)
+    assert y.shape == (2, 8, 8, 8)
+
+
+def test_conv2d_transpose_doubles(key):
+    p = core.conv2d_init(key, 8, 3, 4)
+    x = jnp.ones((2, 8, 8, 8))
+    y = core.conv2d_transpose(p, x, stride=2, padding=1)
+    assert y.shape == (2, 16, 16, 3)
+
+
+def test_conv_transpose_matches_torch_semantics(key):
+    """conv_transpose must be the adjoint of stride-2 conv — verified against
+    torch.nn.functional.conv_transpose2d on identical weights."""
+    torch = pytest.importorskip("torch")
+    p = core.conv2d_init(key, 4, 5, 4)
+    x = np.array(jax.random.normal(jax.random.PRNGKey(1), (2, 6, 6, 4)),
+                 dtype=np.float32)
+    y = core.conv2d_transpose(p, jnp.asarray(x), stride=2, padding=1)
+
+    # torch: NCHW input, (in, out, kh, kw) kernel
+    xt = torch.tensor(x.transpose(0, 3, 1, 2))
+    wt = torch.tensor(np.array(p["w"]).transpose(2, 3, 0, 1))
+    bt = torch.tensor(np.array(p["b"]))
+    yt = torch.nn.functional.conv_transpose2d(xt, wt, bt, stride=2, padding=1)
+    np.testing.assert_allclose(np.array(y).transpose(0, 3, 1, 2),
+                               yt.numpy(), atol=1e-4)
+
+
+def test_dropout_train_eval(key):
+    x = jnp.ones((100, 100))
+    assert np.array_equal(core.dropout(key, x, 0.5, train=False), x)
+    y = core.dropout(key, x, 0.5, train=True)
+    frac = float(jnp.mean(y == 0))
+    assert 0.4 < frac < 0.6
+    kept = np.array(y[y != 0])
+    np.testing.assert_allclose(kept, 2.0, atol=1e-6)
