@@ -65,11 +65,14 @@ def qkv_project(params: dict, x: Array, heads: int):
 
 def dense_attention_weights(q: Array, k: Array, scale: float,
                             mask: Optional[Array], causal: bool,
-                            offset: int = 0) -> Array:
+                            offset: Optional[int] = None) -> Array:
     """Masked softmax attention weights, reference semantics.
 
-    ``offset`` shifts the causal comparison for decode steps where ``q`` holds
-    positions ``[offset, offset + n_q)`` against keys ``[0, n_k)``.
+    ``offset`` gives the absolute position of ``q``'s first row for decode
+    steps where ``q`` holds positions ``[offset, offset + n_q)`` against keys
+    ``[0, n_k)``. ``None`` (the default) end-aligns the queries against the
+    keys — the common decode shape, and plain self-attention when
+    ``n_q == n_k``.
     """
     dots = jnp.einsum("bhid,bhjd->bhij", q, k) * scale
     fill = core.neg_inf(dots.dtype)
@@ -82,11 +85,23 @@ def dense_attention_weights(q: Array, k: Array, scale: float,
 
     if causal:
         n_q, n_k = dots.shape[-2], dots.shape[-1]
-        rows = jnp.arange(n_q)[:, None] + (n_k - n_q if offset == 0 else offset)
+        rows = jnp.arange(n_q)[:, None] + (n_k - n_q if offset is None
+                                           else offset)
         cols = jnp.arange(n_k)[None, :]
         dots = jnp.where(cols <= rows, dots, fill)
 
     return jax.nn.softmax(dots, axis=-1)
+
+
+def output_tail(params: dict, out: Array, *, dropout_rate: float = 0.0,
+                dropout_key: Optional[Array] = None,
+                train: bool = False) -> Array:
+    """Shared post-attention tail: merge heads -> out proj -> dropout
+    (reference transformer.py:61-64). Used by both the dense and the
+    per-layer sparse paths so they cannot drift."""
+    out = merge_heads(out)
+    out = core.linear(params["out"], out)
+    return core.dropout(dropout_key, out, dropout_rate, train)
 
 
 def attention_apply(params: dict, x: Array, *, heads: int, dim_head: int,
@@ -97,6 +112,9 @@ def attention_apply(params: dict, x: Array, *, heads: int, dim_head: int,
                     train: bool = False,
                     impl: str = "xla") -> Array:
     """Full attention block: qkv proj -> attention -> out proj (+dropout)."""
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"unknown attention impl {impl!r}; "
+                         f"expected 'xla' or 'flash'")
     q, k, v = qkv_project(params, x, heads)
 
     if impl == "flash":
@@ -106,7 +124,5 @@ def attention_apply(params: dict, x: Array, *, heads: int, dim_head: int,
         attn = dense_attention_weights(q, k, scale, mask, causal)
         out = jnp.einsum("bhij,bhjd->bhid", attn, v)
 
-    out = merge_heads(out)
-    out = core.linear(params["out"], out)
-    out = core.dropout(dropout_key, out, dropout_rate, train)
-    return out
+    return output_tail(params, out, dropout_rate=dropout_rate,
+                       dropout_key=dropout_key, train=train)

@@ -1,0 +1,134 @@
+"""Reversible execution engine — O(1) activation memory via custom_vjp.
+
+TPU-native rebuild of the reference's RevNet-style engine
+(reference dalle_pytorch/reversible.py:54-157):
+
+  * the input is duplicated into two streams ``x1 = x2 = x``
+    (reference reversible.py:150);
+  * each block computes ``y1 = x1 + f(x2); y2 = x2 + g(y1)`` where ``f`` is
+    the PreNorm attention branch and ``g`` the PreNorm feed-forward branch
+    (reference reversible.py:60-68);
+  * only the FINAL ``(y1, y2)`` is kept; the backward pass reconstructs every
+    intermediate activation by inverting each block
+    (``x2 = y2 - g(y1); x1 = y1 - f(x2)``, reference reversible.py:70-106);
+  * the stack output is the mean of the two streams
+    (reference reversible.py:157).
+
+Where the reference needs a per-device CUDA RNG state snapshot/restore so
+dropout replays identically on the recompute pass (reference
+reversible.py:20-50), this engine simply reuses the same explicit PRNG key in
+forward and backward — JAX's stateless RNG makes the whole ``Deterministic``
+wrapper obsolete (SURVEY.md §2a row 3).
+
+Mechanically: forward is one ``lax.scan`` over depth-stacked layer params
+under ``jax.custom_vjp`` (so XLA sees a single compiled block body and saves
+no per-layer residuals); backward is a reverse ``lax.scan`` that re-derives
+``(x1, x2)`` per block and accumulates parameter cotangents with ``jax.vjp``.
+Compute cost ≈ 2× forward (one inversion + one recompute per branch), the
+trade the reference's README claims (reference README.md:132).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def _branches(cfg):
+    # Imported lazily to avoid a circular import with ops.transformer.
+    from dalle_pytorch_tpu.ops import transformer as T
+
+    def f(lp, h, mask, is_sparse, key, train):
+        return T.attn_branch(lp, h, mask, cfg, is_sparse, key, train)
+
+    def g(lp, h, key, train):
+        return T.ff_branch(lp, h, cfg, key, train)
+
+    return f, g
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _rev_sequence(cfg, train, params, x12, keys, sparse_flags, mask):
+    """Scan the reversible blocks; returns final (y1, y2).
+
+    params: depth-stacked layer pytree. x12: (x1, x2) tuple. keys:
+    (depth, 2, key) dropout keys. sparse_flags: (depth,) bool.
+    """
+    f, g = _branches(cfg)
+
+    def body(carry, xs):
+        x1, x2 = carry
+        lp, lkeys, is_sparse = xs
+        y1 = x1 + f(lp, x2, mask, is_sparse, lkeys[0], train)
+        y2 = x2 + g(lp, y1, lkeys[1], train)
+        return (y1, y2), None
+
+    (y1, y2), _ = lax.scan(body, x12, (params, keys, sparse_flags))
+    return y1, y2
+
+
+def _rev_fwd(cfg, train, params, x12, keys, sparse_flags, mask):
+    y12 = _rev_sequence(cfg, train, params, x12, keys, sparse_flags, mask)
+    # Save only the OUTPUT — no per-layer activations (the whole point;
+    # reference reversible.py:114 saves only ctx.y).
+    return y12, (params, y12, keys, sparse_flags, mask)
+
+
+def _rev_bwd(cfg, train, res, dy12):
+    params, (y1, y2), keys, sparse_flags, mask = res
+    dy1, dy2 = dy12
+    f, g = _branches(cfg)
+
+    def body(carry, xs):
+        y1, y2, dy1, dy2 = carry
+        lp, lkeys, is_sparse = xs
+
+        # Invert g: x2 = y2 - g(y1); cotangents through g into (lp, y1).
+        g_val, g_vjp = jax.vjp(lambda p, h: g(p, h, lkeys[1], train), lp, y1)
+        x2 = y2 - g_val
+        dp_g, dy1_g = g_vjp(dy2)
+        dy1 = dy1 + dy1_g
+
+        # Invert f: x1 = y1 - f(x2); cotangents through f into (lp, x2).
+        f_val, f_vjp = jax.vjp(
+            lambda p, h: f(p, h, mask, is_sparse, lkeys[0], train), lp, x2)
+        x1 = y1 - f_val
+        dp_f, dx2_f = f_vjp(dy1)
+        dx2 = dy2 + dx2_f
+        dx1 = dy1
+
+        dp = jax.tree.map(jnp.add, dp_g, dp_f)
+        return (x1, x2, dx1, dx2), dp
+
+    (x1, x2, dx1, dx2), dparams = lax.scan(
+        body, (y1, y2, dy1, dy2), (params, keys, sparse_flags), reverse=True)
+
+    return dparams, (dx1, dx2), None, None, None
+
+
+_rev_sequence.defvjp(_rev_fwd, _rev_bwd)
+
+
+def reversible_apply(params: dict, x: Array, *, cfg,
+                     mask: Optional[Array] = None,
+                     rng: Optional[Array] = None,
+                     train: bool = False) -> Array:
+    """Reversible transformer stack: duplicate streams, scan blocks, average.
+
+    Matches reference ReversibleSequence.forward (reversible.py:149-157):
+    ``cat([x, x]) -> blocks -> mean of streams`` — here kept as a tuple of
+    two (b, n, dim) streams instead of one (b, n, 2*dim) tensor so each
+    branch's matmuls stay MXU-shaped.
+    """
+    from dalle_pytorch_tpu.ops import transformer as T
+    keys = T._layer_keys(rng, cfg.depth)
+    sparse_flags = jnp.asarray(cfg.sparse_pattern)
+    y1, y2 = _rev_sequence(cfg, train, params, (x, x), keys, sparse_flags,
+                           mask)
+    return (y1 + y2) * 0.5

@@ -138,13 +138,16 @@ def attn_branch(layer_params: dict, x: Array, mask: Optional[Array],
             out = block_sparse_attention(q, k, v, scale=cfg.scale,
                                          causal=cfg.causal, mask=kp_mask,
                                          block=block)
-        else:
+        elif cfg.sparse_impl == "ref":
             out = sparse.sparse_attention_ref(q, k, v, scale=cfg.scale,
                                              causal=cfg.causal, mask=kp_mask,
                                              block=block)
-        out = attn_ops.merge_heads(out)[:, :n]
-        out = core.linear(p["out"], out)
-        return core.dropout(key, out, cfg.attn_dropout, train)
+        else:
+            raise ValueError(f"unknown sparse impl {cfg.sparse_impl!r}; "
+                             f"expected 'ref' or 'pallas'")
+        out = attn_ops.output_tail(p, out, dropout_rate=cfg.attn_dropout,
+                                   dropout_key=key, train=train)
+        return out[:, :n]
 
     if all(pattern):
         return sparse_fn(h)
@@ -172,7 +175,9 @@ def _layer_keys(rng: Optional[Array], depth: int) -> Array:
         # Only reached when dropout is statically off (apply validates) —
         # the keys are dead values threaded through scan for pytree symmetry.
         rng = jax.random.PRNGKey(0)
-    return jax.random.split(rng, depth * 2).reshape(depth, 2, 2)
+    # A (depth, 2) split shape works for both typed keys and legacy uint32
+    # keys (the latter gain a trailing (2,) data axis).
+    return jax.random.split(rng, (depth, 2))
 
 
 def transformer_apply(params: dict, x: Array, *, cfg: TransformerConfig,
