@@ -1,0 +1,9 @@
+"""Model layer (L4): DiscreteVAE, DALLE, CLIP — init/apply pairs + wrappers.
+
+Mirrors the reference's three-model public surface
+(reference dalle_pytorch/__init__.py:1) on the functional ops layer.
+"""
+
+from dalle_pytorch_tpu.models.vae import DiscreteVAE, VAEConfig  # noqa: F401
+from dalle_pytorch_tpu.models.dalle import DALLE, DALLEConfig  # noqa: F401
+from dalle_pytorch_tpu.models.clip import CLIP, CLIPConfig  # noqa: F401

@@ -1,0 +1,393 @@
+"""DALLE — joint text+image autoregressive transformer, TPU-native.
+
+Capability parity with the reference DALLE (reference
+dalle_pytorch/dalle_pytorch.py:241-407):
+
+  * vocab layout ``[0, num_text_tokens) text | [.., +num_image_tokens) image
+    | last = EOS`` (reference :277,303-315,403);
+  * per-position logits mask: positions < text_seq_len-1 predict text ids
+    only, positions >= text_seq_len-1 predict image ids only, EOS only at
+    the final position (reference :303-315) — mask row i governs the token
+    PREDICTED there, i.e. token i+1;
+  * the image embedding is TIED to the VAE codebook (reference :283).  In
+    this functional design DALLE *owns* the table: ``dalle_init`` seeds
+    ``params['image_emb']`` from the VAE codebook, DALLE training updates it,
+    and ``generate_images`` decodes through the VAE convs with DALLE's copy
+    (``models.vae.decode(codebook=...)``) — same semantics as the reference's
+    shared module, explicit instead of aliased;
+  * axial image position embedding.  Default factorizes over the real token
+    grid; ``axial_compat='full_image'`` reproduces the reference quirk of a
+    (image_size × image_size) table of which only the first image_seq_len
+    rows are used (reference :268, SURVEY.md §5 "axial pos-emb quirk");
+  * training loss: one CE over all positions, labels = [text, image+offset]
+    shifted left with EOS appended (reference :398-406);
+  * ``generate_images``: top-k (keep (1-thres)·vocab) then temperature
+    categorical (reference :41-47,339-341) — but as ONE jit-compiled
+    ``lax.scan`` with an on-device KV cache (ops.decode) instead of a python
+    loop of full re-forwards, including the text-completion mode genDALLE
+    exercises by passing a short unpadded prompt (reference genDALLE.py:106).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dalle_pytorch_tpu.models import vae as vae_mod
+from dalle_pytorch_tpu.ops import core, decode as decode_ops
+from dalle_pytorch_tpu.ops import transformer as T
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class DALLEConfig:
+    dim: int
+    depth: int
+    vae: vae_mod.VAEConfig
+    num_text_tokens: int = 10000
+    text_seq_len: int = 256
+    heads: int = 8
+    dim_head: int = 64
+    reversible: bool = False
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    sparse_attn: Union[bool, Tuple[bool, ...]] = False
+    sparse_block: int = 16
+    attn_impl: str = "xla"
+    sparse_impl: str = "ref"
+    scale_mode: str = "dim"     # reference transformer.py:57 uses dim**-0.5
+    remat: str = "none"
+    # 'grid' factorizes over the token grid; 'full_image' reproduces the
+    # reference's (image_size, image_size) table quirk.
+    axial_compat: str = "grid"
+
+    @property
+    def image_seq_len(self) -> int:
+        return self.vae.image_seq_len
+
+    @property
+    def num_image_tokens(self) -> int:
+        return self.vae.num_tokens
+
+    @property
+    def seq_len(self) -> int:
+        return self.text_seq_len + self.image_seq_len
+
+    @property
+    def total_tokens(self) -> int:
+        return self.num_text_tokens + self.num_image_tokens + 1  # + EOS
+
+    @property
+    def eos_token_id(self) -> int:
+        return self.total_tokens - 1
+
+    @property
+    def transformer(self) -> T.TransformerConfig:
+        return T.TransformerConfig(
+            dim=self.dim, depth=self.depth, seq_len=self.seq_len,
+            heads=self.heads, dim_head=self.dim_head, causal=True,
+            attn_dropout=self.attn_dropout, ff_dropout=self.ff_dropout,
+            reversible=self.reversible, sparse_attn=self.sparse_attn,
+            sparse_block=self.sparse_block, attn_impl=self.attn_impl,
+            sparse_impl=self.sparse_impl, scale_mode=self.scale_mode,
+            remat=self.remat)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def dalle_init(key: Array, cfg: DALLEConfig,
+               vae_params: Optional[dict] = None,
+               dtype=jnp.float32) -> dict:
+    """Parameter pytree. ``vae_params`` seeds the tied image embedding from
+    the VAE codebook (reference dalle_pytorch.py:283; requires
+    vae.codebook_dim == dim, as the tie implies)."""
+    ks = jax.random.split(key, 6)
+    g = cfg.vae.grid_size
+
+    if cfg.axial_compat == "full_image":
+        ax_rows, ax_cols = cfg.vae.image_size, cfg.vae.image_size
+    elif cfg.axial_compat == "grid":
+        ax_rows, ax_cols = g, g
+    else:
+        raise ValueError(f"unknown axial_compat {cfg.axial_compat!r}")
+
+    if vae_params is not None:
+        if cfg.vae.codebook_dim != cfg.dim:
+            raise ValueError(
+                "tied codebook requires vae.codebook_dim == dalle dim "
+                f"({cfg.vae.codebook_dim} != {cfg.dim})")
+        image_emb = {"w": vae_params["codebook"]["w"].astype(dtype)}
+    else:
+        image_emb = core.embedding_init(ks[1], cfg.num_image_tokens, cfg.dim,
+                                        dtype)
+
+    return {
+        "text_emb": core.embedding_init(ks[0], cfg.num_text_tokens, cfg.dim,
+                                        dtype),
+        "image_emb": image_emb,
+        "text_pos_emb": core.embedding_init(ks[2], cfg.text_seq_len, cfg.dim,
+                                            dtype),
+        "image_pos_emb": {
+            "rows": core.normal_init(ks[3], (ax_rows, cfg.dim), 1.0, dtype),
+            "cols": core.normal_init(ks[4], (ax_cols, cfg.dim), 1.0, dtype),
+        },
+        "transformer": T.transformer_init(ks[5], cfg.transformer, dtype),
+        "to_logits": {
+            "ln": core.layernorm_init(cfg.dim, dtype),
+            "proj": core.linear_init(jax.random.fold_in(ks[5], 1), cfg.dim,
+                                     cfg.total_tokens, dtype=dtype),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# embeddings / masks
+# ---------------------------------------------------------------------------
+
+def image_pos_emb(params: dict, cfg: DALLEConfig, positions: Array) -> Array:
+    """Summed-axial position embedding for flat image positions
+    (0..image_seq_len). 'grid' maps n -> (n // g, n % g); 'full_image' maps
+    over the image_size-wide table exactly as the reference's
+    AxialPositionalEmbedding(axial_shape=(image_size, image_size)) does."""
+    p = params["image_pos_emb"]
+    width = p["cols"].shape[0]
+    rows = jnp.take(p["rows"], positions // width, axis=0)
+    cols = jnp.take(p["cols"], positions % width, axis=0)
+    return rows + cols
+
+
+def logits_mask(cfg: DALLEConfig) -> Array:
+    """(seq_len, total_tokens) bool, True = FORBIDDEN (fill with -max), the
+    reference's buffer (dalle_pytorch.py:303-315)."""
+    n, t = cfg.seq_len, cfg.total_tokens
+    seq = jnp.arange(n)[:, None]
+    logit = jnp.arange(t)[None, :]
+    text_boundary = cfg.text_seq_len - 1
+    forbidden = (
+        ((seq >= text_boundary) & (logit < cfg.num_text_tokens))
+        | ((seq < text_boundary) & (logit >= cfg.num_text_tokens))
+        | ((seq != (n - 1)) & (logit >= (t - 1)))
+    )
+    return forbidden
+
+
+def embed_prompt(params: dict, cfg: DALLEConfig, text: Array,
+                 image_ids: Optional[Array] = None) -> Array:
+    """Token embeddings for [text (b, t)] ++ [image ids (b, n_img)]."""
+    b, t = text.shape
+    tok = (jnp.take(params["text_emb"]["w"], text, axis=0)
+           + params["text_pos_emb"]["w"][None, :t])
+    if image_ids is not None and image_ids.shape[1] > 0:
+        n_img = image_ids.shape[1]
+        img = (jnp.take(params["image_emb"]["w"], image_ids, axis=0)
+               + image_pos_emb(params, cfg, jnp.arange(n_img))[None])
+        tok = jnp.concatenate([tok, img], axis=1)
+    return tok
+
+
+def to_logits(params: dict, h: Array) -> Array:
+    h = core.layernorm(params["to_logits"]["ln"], h)
+    return core.linear(params["to_logits"]["proj"], h)
+
+
+# ---------------------------------------------------------------------------
+# forward / loss
+# ---------------------------------------------------------------------------
+
+def dalle_apply(params: dict, text: Array, image=None, *, cfg: DALLEConfig,
+                mask: Optional[Array] = None,
+                vae_params: Optional[dict] = None,
+                rng: Optional[Array] = None, train: bool = False,
+                return_loss: bool = False):
+    """Forward (reference DALLE.forward, dalle_pytorch.py:360-407).
+
+    ``image`` may be token ids (b, n_img) int, raw images (b, H, W, C) float
+    (tokenized through the frozen VAE encoder, no gradient — reference
+    :375-378 under @torch.no_grad), or None (text-only prefix).
+    Returns logits (b, seq, total_tokens) or the scalar CE loss.
+    """
+    image_ids = None
+    if image is not None:
+        if image.ndim == 4:
+            if vae_params is None:
+                raise ValueError("raw images need vae_params to tokenize")
+            image_ids = lax.stop_gradient(
+                vae_mod.get_codebook_indices(vae_params, image))
+        else:
+            image_ids = image
+
+    tokens = embed_prompt(params, cfg, text, image_ids)
+    seq_len = tokens.shape[1]
+
+    if mask is not None and image_ids is not None:
+        pad = jnp.ones((mask.shape[0], image_ids.shape[1]), bool)
+        mask = jnp.concatenate([mask, pad], axis=1)
+
+    h = T.transformer_apply(params["transformer"], tokens,
+                            cfg=cfg.transformer, mask=mask, rng=rng,
+                            train=train)
+    logits = to_logits(params, h)
+
+    forbidden = logits_mask(cfg)[:seq_len]
+    logits = jnp.where(forbidden[None], core.neg_inf(logits.dtype), logits)
+
+    if not return_loss:
+        return logits
+
+    if image_ids is None:
+        raise ValueError("when training, image must be supplied")
+
+    labels = jnp.concatenate(
+        [text, image_ids + cfg.num_text_tokens,
+         jnp.full((text.shape[0], 1), cfg.eos_token_id, text.dtype)], axis=1)
+    targets = labels[:, 1:]                      # predict token i+1 at row i
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+# ---------------------------------------------------------------------------
+# generation — jit lax.scan sampler with KV cache
+# ---------------------------------------------------------------------------
+
+def top_k_filter(logits: Array, thres: float) -> Array:
+    """Keep the top (1-thres)·vocab logits, -inf the rest (reference
+    top_k helper, dalle_pytorch.py:41-47)."""
+    k = max(int((1 - thres) * logits.shape[-1]), 1)
+    kth = lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, core.neg_inf(logits.dtype), logits)
+
+
+def generate_images(params: dict, vae_params: dict, text: Array, *,
+                    cfg: DALLEConfig, rng: Array,
+                    mask: Optional[Array] = None,
+                    filter_thres: float = 0.5,
+                    temperature: float = 1.0,
+                    clip_params: Optional[dict] = None,
+                    clip_cfg=None,
+                    return_img_seq: bool = False):
+    """Sample image tokens autoregressively, decode through the VAE.
+
+    Matches the reference sampling distribution (reference
+    dalle_pytorch.py:317-358): per step the masked logits are top-k filtered
+    (keep top half by default) and sampled at ``temperature``; prompts
+    shorter than text_seq_len are completed through the text span first
+    (genDALLE's unpadded-prompt mode). With ``clip_params`` the generated
+    images are scored by CLIP (reference :354-356).
+    """
+    b, t0 = text.shape
+    total_len = cfg.seq_len
+    tcfg = cfg.transformer
+
+    tokens = embed_prompt(params, cfg, text)
+    h, cache = decode_ops.prefill(params["transformer"], tokens, cfg=tcfg,
+                                  total_len=total_len, prompt_mask=mask)
+    key_mask = decode_ops._full_key_mask(mask, b, t0, total_len)
+    forbidden = logits_mask(cfg)
+
+    def sample(logits_row, pred_pos, key):
+        """Sample the token for position pred_pos from last-row logits."""
+        lg = jnp.where(forbidden[pred_pos - 1][None], core.neg_inf(
+            logits_row.dtype), logits_row)
+        lg = top_k_filter(lg, filter_thres)
+        raw = jax.random.categorical(key, lg / temperature, axis=-1)
+        is_image = pred_pos >= cfg.text_seq_len
+        return jnp.where(is_image, raw - cfg.num_text_tokens, raw)
+
+    # token for position t0 from the prefill's last row
+    first_tok = sample(to_logits(params, h[:, -1]), t0,
+                       jax.random.fold_in(rng, t0))
+
+    def step(carry, pos):
+        cur_tok, cache = carry
+        is_text = pos < cfg.text_seq_len
+        text_e = (jnp.take(params["text_emb"]["w"],
+                           jnp.clip(cur_tok, 0, cfg.num_text_tokens - 1),
+                           axis=0)
+                  + params["text_pos_emb"]["w"][
+                      jnp.clip(pos, 0, cfg.text_seq_len - 1)])
+        img_pos = jnp.clip(pos - cfg.text_seq_len, 0, cfg.image_seq_len - 1)
+        img_e = (jnp.take(params["image_emb"]["w"],
+                          jnp.clip(cur_tok, 0, cfg.num_image_tokens - 1),
+                          axis=0)
+                 + image_pos_emb(params, cfg, img_pos))
+        x = jnp.where(is_text, text_e, img_e)
+
+        h_tok, cache = decode_ops.decode_step(params["transformer"], x, pos,
+                                              cache, cfg=tcfg,
+                                              key_mask=key_mask)
+        nxt = sample(to_logits(params, h_tok), pos + 1,
+                     jax.random.fold_in(rng, pos + 1))
+        return (nxt, cache), cur_tok
+
+    positions = jnp.arange(t0, total_len)
+    (_, _), toks = lax.scan(step, (first_tok, cache), positions)
+    toks = jnp.moveaxis(toks, 0, 1)                     # (b, total_len - t0)
+
+    full = jnp.concatenate([text, toks], axis=1)
+    img_seq = full[:, -cfg.image_seq_len:]
+    images = vae_mod.decode(vae_params, img_seq,
+                            codebook=params["image_emb"]["w"])
+
+    if return_img_seq:
+        return images, img_seq
+    if clip_params is not None:
+        from dalle_pytorch_tpu.models import clip as clip_mod
+        text_seq = full[:, :cfg.text_seq_len]
+        scores = clip_mod.clip_apply(clip_params, text_seq, images,
+                                     cfg=clip_cfg)
+        return images, scores
+    return images
+
+
+# ---------------------------------------------------------------------------
+# OO wrapper for reference-API parity
+# ---------------------------------------------------------------------------
+
+class DALLE:
+    """Reference-shaped facade (reference dalle_pytorch.py:241-407) over the
+    functional core. Holds its own params plus the VAE it tokenizes/decodes
+    through."""
+
+    def __init__(self, *, dim: int, vae: vae_mod.DiscreteVAE, depth: int,
+                 key: Optional[Array] = None, params: Optional[dict] = None,
+                 dtype=jnp.float32, **cfg_kwargs):
+        if not isinstance(vae, vae_mod.DiscreteVAE):
+            raise TypeError("vae must be a DiscreteVAE")
+        self.vae = vae
+        self.config = DALLEConfig(dim=dim, depth=depth, vae=vae.config,
+                                  **cfg_kwargs)
+        if params is None:
+            if key is None:
+                key = jax.random.PRNGKey(0)
+            params = dalle_init(key, self.config, vae.params, dtype)
+        self.params = params
+
+    def __call__(self, text: Array, image=None, mask: Optional[Array] = None,
+                 return_loss: bool = False, rng: Optional[Array] = None,
+                 train: bool = False):
+        return dalle_apply(self.params, text, image, cfg=self.config,
+                           mask=mask, vae_params=self.vae.params, rng=rng,
+                           train=train, return_loss=return_loss)
+
+    forward = __call__
+
+    def generate_images(self, text: Array, *, rng: Optional[Array] = None,
+                        clip=None, mask: Optional[Array] = None,
+                        filter_thres: float = 0.5, temperature: float = 1.0):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        kwargs = {}
+        if clip is not None:
+            kwargs = {"clip_params": clip.params, "clip_cfg": clip.config}
+        return generate_images(self.params, self.vae.params, text,
+                               cfg=self.config, rng=rng, mask=mask,
+                               filter_thres=filter_thres,
+                               temperature=temperature, **kwargs)

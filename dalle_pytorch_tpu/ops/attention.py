@@ -27,6 +27,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from dalle_pytorch_tpu.ops import core
 
@@ -77,16 +78,18 @@ def dense_attention_weights(q: Array, k: Array, scale: float,
     dots = jnp.einsum("bhid,bhjd->bhij", q, k) * scale
     fill = core.neg_inf(dots.dtype)
 
+    n_q, n_k = dots.shape[-2], dots.shape[-1]
+    row0 = (n_k - n_q) if offset is None else offset   # abs pos of q row 0
+
     if mask is not None:
-        pair = mask[:, None, :, None] & mask[:, None, None, :]
-        n_q = q.shape[2]
-        pair = pair[:, :, -n_q:, :] if pair.shape[2] != n_q else pair
+        # Query rows use the same absolute positions as the causal check.
+        q_mask = lax.dynamic_slice_in_dim(mask, row0, n_q, axis=1) \
+            if mask.shape[1] != n_q else mask
+        pair = q_mask[:, None, :, None] & mask[:, None, None, :]
         dots = jnp.where(pair, dots, fill)
 
     if causal:
-        n_q, n_k = dots.shape[-2], dots.shape[-1]
-        rows = jnp.arange(n_q)[:, None] + (n_k - n_q if offset is None
-                                           else offset)
+        rows = jnp.arange(n_q)[:, None] + row0
         cols = jnp.arange(n_k)[None, :]
         dots = jnp.where(cols <= rows, dots, fill)
 

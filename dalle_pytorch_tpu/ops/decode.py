@@ -1,0 +1,201 @@
+"""Incremental decoding engine: prefill + single-token step with a KV cache.
+
+The reference samples by re-running the FULL forward for every generated
+token with no KV cache — O(seq²) attention per step, O(seq³) per image
+(reference dalle_pytorch/dalle_pytorch.py:332-337). This module is the
+TPU-native replacement demanded by the north star: a fixed-shape, on-device
+cache so the whole sampling loop jit-compiles into one XLA program
+(models/dalle.py drives it with ``lax.scan``).
+
+Design:
+  * ``init_cache`` allocates (depth, b, heads, total_len, dim_head) K/V
+    buffers once; every step writes one row — no dynamic shapes anywhere.
+  * ``prefill`` runs the prompt through the stack in one batched pass (the
+    queries span [0, t0)), filling cache rows [0, t0).
+  * ``decode_step`` advances one position: the new token's q attends to the
+    cached rows plus itself (its K/V row is concatenated as a 1-wide extra
+    logit, then written back after the layer scan — so the cache is never
+    read-after-written inside a step).
+  * Both execution engines are supported, because generation must run the
+    SAME computation the model was trained with: sequential residual layers,
+    or the two-stream reversible forward whose output is the stream mean
+    (reference reversible.py:149-157 — numerically different from
+    sequential).
+  * Per-layer dense/block-sparse selection works in the cache too: a sparse
+    layer's query at position p sees keys allowed by row p of the
+    (total_len, total_len) VariableSparsity token layout (ops.sparse).
+
+No dropout: decoding is eval-mode by contract (the reference wraps
+generate_images in eval_decorator, reference dalle_pytorch.py:30-36,318).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dalle_pytorch_tpu.ops import attention as attn_ops
+from dalle_pytorch_tpu.ops import core, sparse
+
+Array = jax.Array
+
+
+def init_cache(cfg, batch: int, total_len: int, dtype=jnp.float32) -> dict:
+    shape = (cfg.depth, batch, cfg.heads, total_len, cfg.dim_head)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _full_key_mask(prompt_mask: Optional[Array], batch: int, prompt_len: int,
+                   total_len: int) -> Array:
+    """(b, total_len) bool: prompt pad mask over [0, t0), True beyond — the
+    reference grows its mask with True for every generated position
+    (reference dalle_pytorch.py:344-347)."""
+    full = jnp.ones((batch, total_len), bool)
+    if prompt_mask is not None:
+        full = full.at[:, :prompt_len].set(prompt_mask)
+    return full
+
+
+def _sparse_layout(cfg, total_len: int) -> Array:
+    """(total_len, total_len) token-level allowed mask for sparse layers."""
+    import numpy as np
+    block = cfg.sparse_block
+    padded = ((total_len + block - 1) // block) * block
+    layout = sparse.token_layout_mask(padded, block, causal=cfg.causal)
+    return jnp.asarray(np.asarray(layout)[:total_len, :total_len])
+
+
+def _attn_with_kv(lp: dict, h: Array, allowed: Array, cfg
+                  ) -> Tuple[Array, Array, Array]:
+    """PreNorm attention over an explicit allowed-mask; returns out, k, v.
+
+    h: (b, n, dim); allowed: broadcastable to (b, 1, n, n) (True = attend).
+    """
+    p = lp["attn"]
+    hn = core.layernorm(p["ln"], h)
+    q, k, v = attn_ops.qkv_project(p, hn, cfg.heads)
+    dots = jnp.einsum("bhid,bhjd->bhij", q, k) * cfg.scale
+    dots = jnp.where(allowed, dots, core.neg_inf(dots.dtype))
+    out = jnp.einsum("bhij,bhjd->bhid", jax.nn.softmax(dots, axis=-1), v)
+    out = attn_ops.output_tail(p, out)
+    return out, k, v
+
+
+def prefill(params: dict, x: Array, *, cfg, total_len: int,
+            prompt_mask: Optional[Array] = None) -> Tuple[Array, dict]:
+    """Run the prompt embeddings x (b, t0, dim) through the stack.
+
+    Returns (h_out (b, t0, dim), cache with rows [0, t0) filled).
+    """
+    from dalle_pytorch_tpu.ops import transformer as T
+    b, t0, _ = x.shape
+    sparse_flags = jnp.asarray(cfg.sparse_pattern)
+    any_sparse = any(cfg.sparse_pattern)
+
+    tri = jnp.tril(jnp.ones((t0, t0), bool))[None, None]
+    pad_ok = jnp.ones((b, 1, t0, t0), bool)
+    if prompt_mask is not None:
+        pad_ok = (prompt_mask[:, None, :, None]
+                  & prompt_mask[:, None, None, :])
+    dense_allowed = tri & pad_ok
+    if any_sparse:
+        layout = _sparse_layout(cfg, total_len)[:t0, :t0][None, None]
+        sparse_allowed = dense_allowed & layout
+    else:
+        sparse_allowed = dense_allowed  # dead value for scan symmetry
+
+    def body(carry, xs):
+        lp, is_sparse = xs
+        allowed = jnp.where(is_sparse, sparse_allowed, dense_allowed) \
+            if any_sparse else dense_allowed
+        if cfg.reversible:
+            x1, x2 = carry
+            a, k, v = _attn_with_kv(lp, x2, allowed, cfg)
+            y1 = x1 + a
+            y2 = x2 + T.ff_branch(lp, y1, cfg, None, False)
+            return (y1, y2), (k, v)
+        h = carry
+        a, k, v = _attn_with_kv(lp, h, allowed, cfg)
+        h = h + a
+        h = h + T.ff_branch(lp, h, cfg, None, False)
+        return h, (k, v)
+
+    carry0 = (x, x) if cfg.reversible else x
+    carry, (ks, vs) = lax.scan(body, carry0, (params, sparse_flags))
+    h_out = (carry[0] + carry[1]) * 0.5 if cfg.reversible else carry
+
+    cache = init_cache(cfg, b, total_len, ks.dtype)
+    cache = {
+        "k": lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0)),
+    }
+    return h_out, cache
+
+
+def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,
+                key_mask: Array) -> Tuple[Array, dict]:
+    """Advance one token. x_tok: (b, dim) embedding of the token at position
+    ``pos`` (traced scalar). key_mask: (b, total_len) validity of cache rows
+    (pad-aware; rows >= pos are masked by the causal check regardless).
+
+    Returns (h_out (b, dim), updated cache).
+    """
+    from dalle_pytorch_tpu.ops import transformer as T
+    depth, b, heads, total_len, dh = cache["k"].shape
+    sparse_flags = jnp.asarray(cfg.sparse_pattern)
+    any_sparse = any(cfg.sparse_pattern)
+
+    j = jnp.arange(total_len)
+    causal_ok = j < pos                      # strictly-before rows; self added
+    dense_allowed = causal_ok[None, :] & key_mask            # (b, L)
+    if any_sparse:
+        layout = _sparse_layout(cfg, total_len)
+        row = lax.dynamic_slice(layout, (pos, 0), (1, total_len))[0]
+        sparse_allowed = dense_allowed & row[None, :]
+    else:
+        sparse_allowed = dense_allowed
+
+    h_in = x_tok[:, None, :]                                  # (b, 1, dim)
+
+    def attn_cached(lp, h, ck, cv, is_sparse):
+        p = lp["attn"]
+        hn = core.layernorm(p["ln"], h)
+        q, k, v = attn_ops.qkv_project(p, hn, cfg.heads)      # (b, h, 1, dh)
+        allowed = jnp.where(is_sparse, sparse_allowed, dense_allowed) \
+            if any_sparse else dense_allowed
+        scores = jnp.einsum("bhqd,bhjd->bhqj", q, ck) * cfg.scale
+        scores = jnp.where(allowed[:, None, None, :], scores,
+                           core.neg_inf(scores.dtype))
+        self_score = jnp.einsum("bhqd,bhqd->bhq", q, k)[..., None] * cfg.scale
+        w = jax.nn.softmax(jnp.concatenate([scores, self_score], -1), axis=-1)
+        out = (jnp.einsum("bhqj,bhjd->bhqd", w[..., :-1], cv)
+               + w[..., -1:] * v)
+        return attn_ops.output_tail(p, out), k, v
+
+    def body(carry, xs):
+        lp, ck, cv, is_sparse = xs
+        if cfg.reversible:
+            x1, x2 = carry
+            a, k, v = attn_cached(lp, x2, ck, cv, is_sparse)
+            y1 = x1 + a
+            y2 = x2 + T.ff_branch(lp, y1, cfg, None, False)
+            return (y1, y2), (k, v)
+        h = carry
+        a, k, v = attn_cached(lp, h, ck, cv, is_sparse)
+        h = h + a
+        h = h + T.ff_branch(lp, h, cfg, None, False)
+        return h, (k, v)
+
+    carry0 = (h_in, h_in) if cfg.reversible else h_in
+    carry, (ks, vs) = lax.scan(body, carry0,
+                               (params, cache["k"], cache["v"], sparse_flags))
+    h_out = (carry[0] + carry[1]) * 0.5 if cfg.reversible else carry
+
+    cache = {
+        "k": lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, pos, 0)),
+        "v": lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, pos, 0)),
+    }
+    return h_out[:, 0, :], cache

@@ -145,9 +145,9 @@ def attn_branch(layer_params: dict, x: Array, mask: Optional[Array],
         else:
             raise ValueError(f"unknown sparse impl {cfg.sparse_impl!r}; "
                              f"expected 'ref' or 'pallas'")
-        out = attn_ops.output_tail(p, out, dropout_rate=cfg.attn_dropout,
-                                   dropout_key=key, train=train)
-        return out[:, :n]
+        out = out[:, :, :n]          # drop pad rows before the tail matmul
+        return attn_ops.output_tail(p, out, dropout_rate=cfg.attn_dropout,
+                                    dropout_key=key, train=train)
 
     if all(pattern):
         return sparse_fn(h)

@@ -1,0 +1,125 @@
+"""CLIP tests: pooling, normalization, InfoNCE, rerank integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.models import clip as C
+from dalle_pytorch_tpu.models import dalle as D
+from dalle_pytorch_tpu.models import vae as V
+
+CFG = C.CLIPConfig(dim_text=32, dim_image=32, dim_latent=24,
+                   num_text_tokens=100, text_enc_depth=2, text_seq_len=16,
+                   text_heads=2, visual_enc_depth=2, visual_heads=2,
+                   visual_image_size=32, visual_patch_size=8,
+                   sparse_attn=False)
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def params(key):
+    return C.clip_init(key, CFG)
+
+
+def _batch(key, b=3):
+    kt, ki = jax.random.split(key)
+    text = jax.random.randint(kt, (b, CFG.text_seq_len), 0, 100)
+    imgs = jax.random.uniform(ki, (b, 32, 32, 3), minval=-1, maxval=1)
+    return text, imgs
+
+
+def test_config_patch_divisibility():
+    with pytest.raises(ValueError):
+        C.CLIPConfig(visual_image_size=30, visual_patch_size=8)
+
+
+def test_scores_shape_and_latent_norm(key, params):
+    text, imgs = _batch(key)
+    scores = C.clip_apply(params, text, imgs, cfg=CFG)
+    assert scores.shape == (3,)
+    tl = C.encode_text(params, text, CFG)
+    il = C.encode_image(params, imgs, CFG)
+    np.testing.assert_allclose(np.linalg.norm(np.array(tl), axis=-1), 1.0,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.linalg.norm(np.array(il), axis=-1), 1.0,
+                               rtol=1e-5)
+    # paired scores = diagonal of the sim matrix * exp(temperature)
+    sim = np.array(tl) @ np.array(il).T * np.exp(
+        float(params["temperature"]))
+    np.testing.assert_allclose(np.array(scores), np.diag(sim), atol=1e-5)
+
+
+def test_infonce_loss_one_directional(key, params):
+    text, imgs = _batch(key)
+    loss = C.clip_apply(params, text, imgs, cfg=CFG, return_loss=True)
+    tl = np.array(C.encode_text(params, text, CFG))
+    il = np.array(C.encode_image(params, imgs, CFG))
+    sim = tl @ il.T * np.exp(float(params["temperature"]))
+    logp = sim - np.log(np.exp(sim).sum(-1, keepdims=True))
+    manual = -np.mean(np.diag(logp))       # text->image CE vs arange labels
+    np.testing.assert_allclose(float(loss), manual, rtol=1e-4)
+
+
+def test_masked_mean_pooling(key, params):
+    text, imgs = _batch(key)
+    mask = jnp.ones((3, CFG.text_seq_len), bool).at[:, 8:].set(False)
+    a = C.clip_apply(params, text, imgs, cfg=CFG, text_mask=mask)
+    b = C.clip_apply(params, text, imgs, cfg=CFG)
+    assert not np.allclose(np.array(a), np.array(b))
+    # masked_mean ignores padded rows entirely
+    t = jax.random.normal(key, (2, 4, 8))
+    m = jnp.asarray([[True, True, False, False], [True, False, False, False]])
+    got = C.masked_mean(t, m)
+    np.testing.assert_allclose(np.array(got[0]),
+                               np.array(t[0, :2].mean(0)), rtol=1e-5)
+    np.testing.assert_allclose(np.array(got[1]), np.array(t[1, 0]), rtol=1e-5)
+
+
+def test_patchify_feature_order():
+    """(p1, p2, c) ordering — row within patch is the slowest feature axis
+    (weight-layout parity with the reference rearrange)."""
+    img = jnp.arange(2 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 4, 3)
+    patches = C.patchify(img, 2)
+    assert patches.shape == (2, 4, 12)
+    first = np.array(patches[0, 0]).reshape(2, 2, 3)
+    np.testing.assert_array_equal(first, np.array(img[0, :2, :2, :]))
+
+
+def test_gradients_flow(key, params):
+    text, imgs = _batch(key)
+    g = jax.grad(lambda p: C.clip_apply(p, text, imgs, cfg=CFG,
+                                        return_loss=True))(params)
+    assert float(jnp.abs(g["temperature"]).sum()) > 0
+    for leaf in jax.tree.leaves(g):
+        assert np.isfinite(np.array(leaf)).all()
+
+
+def test_sparse_default_runs(key):
+    cfg = C.CLIPConfig(dim_text=32, dim_image=32, dim_latent=24,
+                       num_text_tokens=50, text_enc_depth=1, text_seq_len=32,
+                       text_heads=2, visual_enc_depth=1, visual_heads=2,
+                       visual_image_size=32, visual_patch_size=4)
+    assert cfg.sparse_attn is True          # the reference default
+    params = C.clip_init(key, cfg)
+    text = jax.random.randint(key, (2, 32), 0, 50)
+    imgs = jax.random.uniform(key, (2, 32, 32, 3))
+    scores = C.clip_apply(params, text, imgs, cfg=cfg)
+    assert np.isfinite(np.array(scores)).all()
+
+
+def test_rerank_integration(key):
+    vae = V.DiscreteVAE(key, image_size=32, num_tokens=48, codebook_dim=32,
+                        num_layers=2, hidden_dim=16)
+    dalle = D.DALLE(dim=32, vae=vae, depth=1, key=key, num_text_tokens=100,
+                    text_seq_len=16, heads=2, dim_head=16)
+    clip = C.CLIP(key, **{**CFG.__dict__})
+    text = jax.random.randint(key, (2, 16), 0, 100)
+    images, scores = dalle.generate_images(text, rng=key, clip=clip)
+    assert images.shape == (2, 32, 32, 3)
+    assert scores.shape == (2,)
+    assert np.isfinite(np.array(scores)).all()

@@ -80,23 +80,37 @@ def test_gradients_under_jit(key):
 
 
 def test_dropout_replays_identically(key):
-    """Same PRNG key in forward and recompute => gradients are well-defined
-    and deterministic (the property the reference needs CUDA RNG snapshots
-    for, reference reversible.py:20-50; free with stateless keys)."""
+    """Dropout gradients through the inversion-based backward must match
+    plain autodiff of the same two-stream forward with the SAME per-layer
+    keys — i.e. the recompute pass replays the forward's dropout masks (the
+    property the reference needs CUDA RNG snapshots for, reference
+    reversible.py:20-50; free with stateless keys, but only if the backward
+    routes the keys correctly)."""
     cfg = TransformerConfig(dim=32, depth=2, seq_len=16, heads=2, dim_head=16,
                             reversible=True, attn_dropout=0.3, ff_dropout=0.3)
     params = transformer_init(key, cfg)
     x = jax.random.normal(key, (1, 16, 32))
     r = jax.random.PRNGKey(3)
+    keys = T._layer_keys(r, cfg.depth)
 
-    def loss(p):
+    def plain_loss(p):
+        x1 = x2 = x
+        for i in range(cfg.depth):
+            lp = jax.tree.map(lambda a: a[i], p)
+            y1 = x1 + T.attn_branch(lp, x2, None, cfg, False, keys[i, 0],
+                                    True)
+            y2 = x2 + T.ff_branch(lp, y1, cfg, keys[i, 1], True)
+            x1, x2 = y1, y2
+        return jnp.sum(((x1 + x2) * 0.5) ** 2)
+
+    def rev_loss(p):
         return jnp.sum(
             transformer_apply(p, x, cfg=cfg, rng=r, train=True) ** 2)
 
-    g1 = jax.grad(loss)(params)
-    g2 = jax.grad(loss)(params)
-    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
-        np.array(a), np.array(b)), g1, g2)
+    g_rev = jax.grad(rev_loss)(params)
+    g_plain = jax.grad(plain_loss)(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.array(a), np.array(b), atol=1e-4), g_rev, g_plain)
 
 
 def test_reversible_with_sparse_pattern(key):
@@ -124,10 +138,13 @@ def test_memory_contract_no_per_layer_residuals(key):
     x = jax.random.normal(key, (2, 16, 32))
     _, vjp_fn = jax.vjp(
         lambda p, x: transformer_apply(p, x, cfg=CFG), params, x)
-    leaves = jax.tree.leaves(vjp_fn)
+    leaves = [a for a in jax.tree.leaves(vjp_fn) if hasattr(a, "size")]
+    b, n = x.shape[0], x.shape[1]
+    # any leaf as big as a depth-stacked activation (regardless of layout)
+    # that is not one of the stacked parameter tensors is a stash
+    param_sizes = {a.size for a in jax.tree.leaves(params)}
+    act_size = CFG.depth * b * n * CFG.dim
     act_like = [a for a in leaves
-                if hasattr(a, "shape") and a.ndim >= 3
-                and a.shape[-1] == CFG.dim and a.shape[-2] == 16
-                and a.ndim >= 4 and a.shape[0] == CFG.depth]
+                if a.size >= act_size and a.size not in param_sizes]
     assert not act_like, f"found per-layer activation stash: " \
                          f"{[a.shape for a in act_like]}"

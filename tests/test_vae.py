@@ -1,0 +1,170 @@
+"""DiscreteVAE tests: shapes, contracts, gradient flow, torch golden checks.
+
+Contracts from SURVEY.md §5: token grid = (image_size / 2**num_layers)²,
+get_codebook_indices = channel argmax flattened row-major, decode assumes a
+square grid, recon loss is MSE, Gumbel path is the soft relaxation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.models.vae import (DiscreteVAE, VAEConfig, decode,
+                                          get_codebook_indices, vae_apply,
+                                          vae_init)
+from dalle_pytorch_tpu.ops import core
+
+CFG = VAEConfig(image_size=32, num_tokens=64, codebook_dim=32, num_layers=2,
+                hidden_dim=16)
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def params(key):
+    return vae_init(key, CFG)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        VAEConfig(image_size=100)
+    with pytest.raises(ValueError):
+        VAEConfig(num_layers=0)
+
+
+def test_recon_shapes_and_loss(key, params):
+    imgs = jax.random.uniform(key, (2, 32, 32, 3), minval=-1, maxval=1)
+    recon = vae_apply(params, imgs, cfg=CFG, rng=key)
+    assert recon.shape == imgs.shape
+    loss = vae_apply(params, imgs, cfg=CFG, rng=key, return_recon_loss=True)
+    assert loss.shape == ()
+    # loss is the plain MSE of the same forward (reference dalle_pytorch.py:156)
+    np.testing.assert_allclose(
+        float(loss), float(jnp.mean((imgs - recon) ** 2)), rtol=1e-5)
+
+
+def test_logits_grid_shape(key, params):
+    imgs = jax.random.uniform(key, (2, 32, 32, 3))
+    logits = vae_apply(params, imgs, cfg=CFG, rng=key, return_logits=True)
+    g = CFG.grid_size
+    assert logits.shape == (2, g, g, CFG.num_tokens)
+    assert CFG.image_seq_len == g * g == 64
+
+
+def test_codebook_indices_argmax_rowmajor(key, params):
+    imgs = jax.random.uniform(key, (2, 32, 32, 3))
+    ids = get_codebook_indices(params, imgs)
+    assert ids.shape == (2, CFG.image_seq_len)
+    logits = vae_apply(params, imgs, cfg=CFG, rng=key, return_logits=True)
+    manual = np.argmax(np.array(logits), axis=-1).reshape(2, -1)
+    np.testing.assert_array_equal(np.array(ids), manual)
+
+
+def test_decode_roundtrip_shape(key, params):
+    ids = jax.random.randint(key, (2, CFG.image_seq_len), 0, CFG.num_tokens)
+    imgs = decode(params, ids)
+    assert imgs.shape == (2, 32, 32, 3)
+
+
+def test_decode_codebook_override(key, params):
+    """DALLE owns the tied codebook after training; decode must honor an
+    external table (reference tying, dalle_pytorch.py:283)."""
+    ids = jax.random.randint(key, (1, CFG.image_seq_len), 0, CFG.num_tokens)
+    alt = jax.random.normal(key, (CFG.num_tokens, CFG.codebook_dim))
+    a = decode(params, ids)
+    b = decode(params, ids, codebook=alt)
+    assert not np.allclose(np.array(a), np.array(b))
+
+
+def test_gradients_flow_to_all_params(key, params):
+    imgs = jax.random.uniform(key, (2, 32, 32, 3), minval=-1, maxval=1)
+
+    def loss_fn(p):
+        return vae_apply(p, imgs, cfg=CFG, rng=key, return_recon_loss=True)
+
+    grads = jax.grad(loss_fn)(params)
+    for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
+        assert np.isfinite(np.array(g)).all(), path
+        assert float(jnp.abs(g).sum()) > 0, f"zero grad at {path}"
+
+
+def test_resnet_blocks_variant(key):
+    cfg = VAEConfig(image_size=32, num_tokens=32, codebook_dim=24,
+                    num_layers=2, num_resnet_blocks=2, hidden_dim=16)
+    params = vae_init(key, cfg)
+    imgs = jax.random.uniform(key, (1, 32, 32, 3))
+    recon = vae_apply(params, imgs, cfg=cfg, rng=key)
+    assert recon.shape == imgs.shape
+    loss = vae_apply(params, imgs, cfg=cfg, rng=key, return_recon_loss=True)
+    assert np.isfinite(float(loss))
+
+
+def test_temperature_override_no_recompile_semantics(key, params):
+    imgs = jax.random.uniform(key, (1, 32, 32, 3))
+    a = vae_apply(params, imgs, cfg=CFG, rng=key, temperature=0.9)
+    b = vae_apply(params, imgs, cfg=CFG, rng=key, temperature=0.1)
+    # colder temperature sharpens the mix => different recon
+    assert not np.allclose(np.array(a), np.array(b))
+
+
+def test_straight_through_uses_hard_onehot(key):
+    cfg = VAEConfig(image_size=32, num_tokens=32, codebook_dim=24,
+                    num_layers=2, hidden_dim=16, straight_through=True)
+    params = vae_init(key, cfg)
+    imgs = jax.random.uniform(key, (1, 32, 32, 3))
+    # straight-through recon == decoding the hard argmax of noisy logits;
+    # still differentiable
+    g = jax.grad(lambda p: vae_apply(p, imgs, cfg=cfg, rng=key,
+                                     return_recon_loss=True))(params)
+    assert float(jnp.abs(g["codebook"]["w"]).sum()) > 0
+
+
+def test_oo_wrapper_parity(key):
+    vae = DiscreteVAE(key, image_size=32, num_tokens=64, codebook_dim=32,
+                      num_layers=2, hidden_dim=16)
+    assert vae.image_size == 32 and vae.num_tokens == 64
+    imgs = jax.random.uniform(key, (1, 32, 32, 3))
+    ids = vae.get_codebook_indices(imgs)
+    np.testing.assert_array_equal(
+        np.array(ids), np.array(get_codebook_indices(vae.params, imgs)))
+
+
+def test_conv_transpose_matches_torch():
+    """Golden primitive check: our input-dilated conv == torch's
+    ConvTranspose2d(k=4, stride=2, padding=1) — the dVAE upsampler shape."""
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 8, 8, 5), dtype=np.float32)
+    w = rng.standard_normal((4, 4, 5, 7), dtype=np.float32) * 0.1
+    b = rng.standard_normal(7, dtype=np.float32)
+
+    ours = core.conv2d_transpose({"w": jnp.asarray(w), "b": jnp.asarray(b)},
+                                 jnp.asarray(x), stride=2, padding=1)
+
+    tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
+    # torch ConvTranspose2d weight layout: (in, out, kh, kw)
+    tw = torch.from_numpy(w.transpose(2, 3, 0, 1))
+    ty = torch.nn.functional.conv_transpose2d(
+        tx, tw, torch.from_numpy(b), stride=2, padding=1)
+    np.testing.assert_allclose(np.array(ours),
+                               ty.numpy().transpose(0, 2, 3, 1), atol=1e-4)
+
+
+def test_conv2d_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 9, 9, 4), dtype=np.float32)
+    w = rng.standard_normal((4, 4, 4, 6), dtype=np.float32) * 0.1
+    b = rng.standard_normal(6, dtype=np.float32)
+    ours = core.conv2d({"w": jnp.asarray(w), "b": jnp.asarray(b)},
+                       jnp.asarray(x), stride=2, padding=1)
+    tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
+    tw = torch.from_numpy(w.transpose(3, 2, 0, 1))  # (out, in, kh, kw)
+    ty = torch.nn.functional.conv2d(tx, tw, torch.from_numpy(b), stride=2,
+                                    padding=1)
+    np.testing.assert_allclose(np.array(ours),
+                               ty.numpy().transpose(0, 2, 3, 1), atol=1e-4)
