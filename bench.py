@@ -1,0 +1,95 @@
+"""Benchmark harness — prints ONE JSON line with the north-star metric.
+
+Measures steady-state training throughput (tokens/sec/chip) of the
+BASELINE depth-12 dim-512 DALLE over the full 1280-token text+image
+sequence, bfloat16 activations, jit train step with adam — the
+`north_star` config of /root/repo/BASELINE.json.
+
+``vs_baseline``: the reference publishes NO numbers (BASELINE.md), so the
+comparison point is an estimated A100 throughput for the same model derived
+from its FLOP count: ~430 MFLOPs/token (6*56M matmul params + attention)
+at 40% MFU of 312 bf16 TFLOPs => ~2.9e5 tokens/sec. vs_baseline =
+measured / 2.9e5; the >= 1.5 target corresponds to the north star's
+">= 1.5x A100 tokens/sec/chip".
+
+Usage: python bench.py [--tiny] [--steps N] [--batch B]
+  --tiny shrinks the model for CPU smoke runs (not a valid benchmark).
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+A100_TOKENS_PER_SEC_EST = 2.9e5
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+
+    from dalle_pytorch_tpu.models import dalle as D
+    from dalle_pytorch_tpu.models import vae as V
+    from dalle_pytorch_tpu.parallel.train import dalle_loss_fn
+
+    if args.tiny:
+        vcfg = V.VAEConfig(image_size=16, num_tokens=32, codebook_dim=32,
+                           num_layers=2, hidden_dim=8)
+        cfg = D.DALLEConfig(dim=32, depth=2, vae=vcfg, num_text_tokens=64,
+                            text_seq_len=8, heads=2, dim_head=16)
+    else:
+        vcfg = V.VAEConfig(image_size=256, num_tokens=2048, codebook_dim=512,
+                           num_layers=3, hidden_dim=64)
+        cfg = D.DALLEConfig(dim=512, depth=12, vae=vcfg,
+                            num_text_tokens=10000, text_seq_len=256)
+
+    key = jax.random.PRNGKey(0)
+    params = D.dalle_init(key, cfg, dtype=jnp.bfloat16)
+    opt = optax.adam(1e-4)
+    loss_fn = dalle_loss_fn(cfg)
+
+    b = args.batch
+    batch = {
+        "text": jax.random.randint(key, (b, cfg.text_seq_len), 0,
+                                   cfg.num_text_tokens),
+        "image": jax.random.randint(key, (b, cfg.image_seq_len), 0,
+                                    cfg.num_image_tokens),
+    }
+
+    from dalle_pytorch_tpu.parallel.train import make_train_step
+    step = make_train_step(loss_fn, opt)
+    opt_state = opt.init(params)
+
+    for i in range(max(args.warmup, 1)):
+        params, opt_state, loss = step(params, opt_state, batch,
+                                       jax.random.fold_in(key, i))
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, batch,
+                                       jax.random.fold_in(key, 100 + i))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = args.steps * b * cfg.seq_len
+    n_chips = max(jax.device_count(), 1)
+    tps_chip = tokens / dt / n_chips
+    print(json.dumps({
+        "metric": "DALLE train tokens/sec/chip (depth-12 dim-512, seq 1280)"
+                  if not args.tiny else "tiny smoke tokens/sec/chip",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tps_chip / A100_TOKENS_PER_SEC_EST, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

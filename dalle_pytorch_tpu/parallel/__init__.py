@@ -1,0 +1,16 @@
+"""Parallelism layer (L1.5) — mesh, shardings, collectives, ring attention.
+
+The reference has NO distributed support (SURVEY.md §2.12: no
+torch.distributed, no NCCL/MPI, single device everywhere); this layer is the
+from-scratch TPU-native design the north star requires: a
+``jax.sharding.Mesh`` over ICI/DCN, ``jit``/``pjit`` with NamedShardings for
+data/tensor parallel training (XLA inserts the psum/all-gather collectives),
+and ``shard_map`` + ``ppermute``/``all_to_all`` kernels for sequence/context
+parallelism over long sequences.
+"""
+
+from dalle_pytorch_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh, named_sharding, replicate, shard_batch)
+from dalle_pytorch_tpu.parallel.ring import (  # noqa: F401
+    ring_attention, ulysses_attention)
+from dalle_pytorch_tpu.parallel.train import make_train_step  # noqa: F401

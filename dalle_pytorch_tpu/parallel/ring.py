@@ -1,0 +1,145 @@
+"""Sequence/context-parallel attention: ring (ppermute) and Ulysses
+(all-to-all) kernels.
+
+Long-context support the reference lacks entirely (SURVEY.md §5.7: no ring
+attention, no context parallel — it scales sequence cost only by reversible
+layers and block-sparse attention on ONE device). Here the sequence axis is
+sharded over a mesh axis and attention runs as an SPMD program:
+
+  * ``ring_attention`` — each device holds a sequence shard of q/k/v. K/V
+    blocks rotate around the ring with ``lax.ppermute`` (ICI
+    neighbor-to-neighbor, bandwidth-optimal) while each device folds one
+    block per step into a numerically-stable online-softmax accumulator
+    (the flash-attention recurrence, so no (n, n) matrix ever exists).
+    Causal masking is block-aware: blocks wholly in the future contribute
+    nothing (their weights underflow to exactly zero via the -inf mask).
+  * ``ulysses_attention`` — all-to-all re-shards sequence -> heads, runs
+    ordinary dense attention on full sequences for the local head group,
+    and all-to-alls back. One collective round-trip instead of a ring of
+    size-1 hops; better when heads >= mesh axis size and the sequence fits.
+
+Both are exact (same math as dense attention) — parity tests drive them on
+the virtual CPU mesh against the single-device oracle.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map            # jax >= 0.8
+except ImportError:                      # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def _online_block(carry, kb, vb, q, scale, allow):
+    """Fold one K/V block into the online-softmax state.
+
+    carry: (m, l, acc) with m,l (b,h,nl,1) and acc (b,h,nl,d).
+    allow: (nl_q, nl_k) bool — True where attention is permitted.
+    """
+    m, l, acc = carry
+    s = jnp.einsum("bhid,bhjd->bhij", q, kb) * scale
+    neg = jnp.asarray(-jnp.inf, s.dtype)
+    s = jnp.where(allow[None, None], s, neg)
+
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    # rows with no allowed key yet keep m=-inf; shift with 0 to avoid nans
+    shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - shift)
+    p = jnp.where(allow[None, None], p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
+    l = l * alpha + p.sum(axis=-1, keepdims=True)
+    acc = acc * alpha + jnp.einsum("bhij,bhjd->bhid", p, vb)
+    return m_new, l, acc
+
+
+def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
+                   causal: bool = True, scale: Optional[float] = None,
+                   batch_axis: Optional[str] = None):
+    """Exact attention with the sequence axis sharded over ``axis``.
+
+    q, k, v: (b, h, n, d) GLOBAL shapes; n divides by the axis size.
+    Returns (b, h, n, d) sharded the same way. ``batch_axis`` optionally
+    names a mesh axis the batch dim is sharded over (pure SPMD pass-through).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    size = mesh.shape[axis]
+
+    def local(q, k, v):
+        nl = q.shape[2]
+        rank = lax.axis_index(axis)
+        rows = rank * nl + jnp.arange(nl)
+
+        # init the accumulators FROM q so they carry the same device-varying
+        # type as the scan's rotating kb/vb under shard_map
+        m = q[..., :1] * 0.0 - jnp.inf
+        l = q[..., :1] * 0.0
+        acc = q * 0.0
+        perm = [(i, (i + 1) % size) for i in range(size)]
+
+        def step(s, state):
+            m, l, acc, kb, vb = state
+            src = (rank - s) % size          # who produced the block we hold
+            cols = src * nl + jnp.arange(nl)
+            allow = (cols[None, :] <= rows[:, None]) if causal else \
+                jnp.ones((nl, nl), bool)
+            m, l, acc = _online_block((m, l, acc), kb, vb, q, scale, allow)
+            kb = lax.ppermute(kb, axis, perm)
+            vb = lax.ppermute(vb, axis, perm)
+            return m, l, acc, kb, vb
+
+        m, l, acc, _, _ = lax.fori_loop(
+            0, size, step, (m, l, acc, k, v), unroll=True)
+        return acc / jnp.where(l == 0.0, 1.0, l)
+
+    spec = P(batch_axis, None, axis, None)
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
+
+
+def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
+                      causal: bool = True, scale: Optional[float] = None,
+                      batch_axis: Optional[str] = None):
+    """Exact attention via head<->sequence all-to-all re-sharding.
+
+    q, k, v: (b, h, n, d) global; h divides by the axis size. Inside the
+    shard_map each device swaps its sequence shard for a head shard
+    (all_to_all over ICI), attends over the FULL sequence for its heads,
+    then swaps back.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    size = mesh.shape[axis]
+    if q.shape[1] % size != 0:
+        raise ValueError(f"heads {q.shape[1]} not divisible by mesh axis "
+                         f"{axis} ({size})")
+
+    def local(q, k, v):
+        # local shapes: (b, h, nl, d) -> all_to_all -> (b, h/size, n, d)
+        def seq_to_heads(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        def heads_to_seq(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+        s = jnp.einsum("bhid,bhjd->bhij", qh, kh) * scale
+        if causal:
+            n = s.shape[-1]
+            tri = jnp.tril(jnp.ones((n, n), bool))
+            s = jnp.where(tri[None, None], s, -jnp.inf)
+        out = jnp.einsum("bhij,bhjd->bhid", jax.nn.softmax(s, axis=-1), vh)
+        return heads_to_seq(out)
+
+    spec = P(batch_axis, None, axis, None)
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)

@@ -1,0 +1,165 @@
+"""Sharded training steps: jit + NamedShardings, collectives by XLA.
+
+The idiomatic TPU recipe (scaling-book style): pick a mesh, place params and
+batch with NamedShardings, jit the step — XLA/GSPMD inserts the gradient
+psum over `dp`, the all-gathers/reduce-scatters implied by `fsdp`, and the
+activation collectives implied by `tp`, all riding ICI. There is no userland
+communication library to port (the reference has none anyway, SURVEY.md
+§2.12); the mesh IS the backend.
+
+Usage:
+    mesh = make_mesh({'dp': 4, 'tp': 2})
+    specs = dalle_param_specs(params, tp='tp')           # or fsdp='dp'
+    params, opt_state = setup_sharded(params, optimizer, mesh, specs)
+    step = make_train_step(loss_fn, optimizer)
+    batch = shard_batch(mesh, batch)
+    params, opt_state, loss = step(params, opt_state, batch, rng)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+
+def make_train_step(loss_fn: Callable, optimizer) -> Callable:
+    """jit step: (params, opt_state, batch, rng) -> (params, opt_state, loss).
+
+    ``loss_fn(params, batch, rng) -> scalar``. Shardings are dictated by the
+    inputs (set up with ``setup_sharded``/``shard_batch``); params and opt
+    state buffers are donated.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def setup_sharded(params, optimizer, mesh: Mesh, param_specs=None):
+    """Place params per ``param_specs`` (replicated when None) and build the
+    optimizer state THROUGH jit so its moment buffers inherit the param
+    shardings (the standard GSPMD propagation trick)."""
+    if param_specs is None:
+        shardings = NamedSharding(mesh, P())
+        params = jax.device_put(params, shardings)
+    else:
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), param_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        params = jax.tree.map(jax.device_put, params, shardings)
+    opt_state = jax.jit(optimizer.init)(params)
+    return params, opt_state
+
+
+# ---------------------------------------------------------------------------
+# partition-spec rules for the framework's parameter trees
+# ---------------------------------------------------------------------------
+
+def _dalle_rule(tp: Optional[str], fsdp: Optional[str]):
+    """Spec by (sub-module, leaf) name for DALLE/transformer params.
+
+    Transformer layer params are depth-stacked (leading depth axis) — that
+    axis shards over ``fsdp`` (ZeRO-style: each device stores a slice of
+    every layer stack, all-gathered per scan step). ``tp`` follows the
+    Megatron pattern: qkv/w1 column-parallel, out/w2 row-parallel, so each
+    layer needs exactly one psum on the attention output and one on the FF
+    output — inserted by XLA from the shardings alone.
+    """
+    def rule(path, leaf):
+        keys = [getattr(k, "key", None) for k in path]
+        if "transformer" in keys:
+            sub, name = keys[-2], keys[-1]
+            if name == "w":
+                if sub in ("qkv", "w1"):
+                    return P(fsdp, None, tp)      # column parallel
+                if sub in ("out", "w2"):
+                    return P(fsdp, tp, None)      # row parallel
+            if name == "b" and sub == "w1":
+                return P(fsdp, tp)
+            return P(fsdp)                         # ln params, out/w2 bias
+        if keys[-2] == "proj":                     # to_logits
+            return P(None, tp) if keys[-1] == "w" else P(tp)
+        return P()                                 # embeddings replicated
+    return rule
+
+
+def dalle_param_specs(params, tp: Optional[str] = None,
+                      fsdp: Optional[str] = None,
+                      mesh: Optional[Mesh] = None):
+    """PartitionSpec tree for a DALLE (or bare transformer) param tree.
+
+    With ``mesh``, any axis whose dimension is not divisible by the mesh
+    axis size is dropped back to replicated for that dim (e.g. the
+    total_tokens logits dim with an odd vocab size).
+    """
+    rule = _dalle_rule(tp, fsdp)
+
+    def checked(path, leaf):
+        spec = rule(path, leaf)
+        if mesh is None:
+            return spec
+        fixed = tuple(
+            a if (a is None or leaf.shape[i] % mesh.shape[a] == 0) else None
+            for i, a in enumerate(spec))
+        return P(*fixed)
+
+    return jax.tree_util.tree_map_with_path(checked, params)
+
+
+# ---------------------------------------------------------------------------
+# model-specific loss closures
+# ---------------------------------------------------------------------------
+
+def vae_loss_fn(cfg, *, smooth_l1: bool = False, temperature=None):
+    """Batch = {'images': (b, H, W, C)}. The training scripts' loss is
+    smooth_l1 + mse (reference trainVAE.py:87) while the model's built-in is
+    mse-only (reference dalle_pytorch.py:156); ``smooth_l1`` selects the
+    script behavior."""
+    from dalle_pytorch_tpu.models import vae as V
+    import jax.numpy as jnp
+
+    def loss(params, batch, rng):
+        imgs = batch["images"]
+        recon = V.vae_apply(params, imgs, cfg=cfg, rng=rng,
+                            temperature=temperature)
+        mse = jnp.mean(jnp.square(imgs - recon))
+        if not smooth_l1:
+            return mse
+        d = jnp.abs(imgs - recon)
+        huber = jnp.mean(jnp.where(d < 1.0, 0.5 * d * d, d - 0.5))
+        return huber + mse
+
+    return loss
+
+
+def dalle_loss_fn(cfg, vae_params=None):
+    """Batch = {'text': (b, t), 'image': ids (b, n) or raw images,
+    'mask': optional (b, t)}."""
+    from dalle_pytorch_tpu.models import dalle as D
+
+    def loss(params, batch, rng):
+        return D.dalle_apply(params, batch["text"], batch["image"], cfg=cfg,
+                             mask=batch.get("mask"), vae_params=vae_params,
+                             rng=rng, train=True, return_loss=True)
+
+    return loss
+
+
+def clip_loss_fn(cfg):
+    from dalle_pytorch_tpu.models import clip as C
+
+    def loss(params, batch, rng):
+        return C.clip_apply(params, batch["text"], batch["images"], cfg=cfg,
+                            text_mask=batch.get("mask"), return_loss=True)
+
+    return loss

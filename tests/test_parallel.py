@@ -1,0 +1,158 @@
+"""Parallelism tests on the virtual 8-device CPU mesh (SURVEY.md §4e).
+
+Ring/Ulysses attention parity vs the dense oracle; data-parallel step
+equivalence vs single-device; tp/fsdp sharded DALLE step runs and matches
+the replicated step's loss.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dalle_pytorch_tpu.models import dalle as D
+from dalle_pytorch_tpu.models import vae as V
+from dalle_pytorch_tpu.parallel import (make_mesh, make_train_step,
+                                        replicate, ring_attention,
+                                        shard_batch, ulysses_attention)
+from dalle_pytorch_tpu.parallel.train import (dalle_loss_fn,
+                                              dalle_param_specs,
+                                              setup_sharded, vae_loss_fn)
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)
+
+
+def dense_oracle(q, k, v, causal):
+    s = jnp.einsum("bhid,bhjd->bhij", q, k) * (q.shape[-1] ** -0.5)
+    if causal:
+        n = s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((n, n), bool))[None, None], s,
+                      -jnp.inf)
+    return jnp.einsum("bhij,bhjd->bhid", jax.nn.softmax(s, -1), v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(key, causal):
+    mesh = make_mesh({"sp": 8})
+    q, k, v = jax.random.normal(key, (3, 2, 4, 64, 16))
+    out = ring_attention(q, k, v, mesh=mesh, axis="sp", causal=causal)
+    np.testing.assert_allclose(np.array(out),
+                               np.array(dense_oracle(q, k, v, causal)),
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_dense(key, causal):
+    mesh = make_mesh({"sp": 8})
+    q, k, v = jax.random.normal(key, (3, 2, 8, 64, 16))
+    out = ulysses_attention(q, k, v, mesh=mesh, axis="sp", causal=causal)
+    np.testing.assert_allclose(np.array(out),
+                               np.array(dense_oracle(q, k, v, causal)),
+                               atol=2e-5)
+
+
+def test_ring_attention_2d_mesh_with_dp(key):
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    q, k, v = jax.random.normal(key, (3, 2, 4, 32, 16))
+    out = ring_attention(q, k, v, mesh=mesh, axis="sp", causal=True,
+                         batch_axis="dp")
+    np.testing.assert_allclose(np.array(out),
+                               np.array(dense_oracle(q, k, v, True)),
+                               atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(key):
+    mesh = make_mesh({"sp": 8})
+    q = k = v = jnp.zeros((1, 4, 16, 8))
+    with pytest.raises(ValueError):
+        ulysses_attention(q, k, v, mesh=mesh, axis="sp")
+
+
+def test_mesh_validation():
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3})
+
+
+VCFG = V.VAEConfig(image_size=16, num_tokens=32, codebook_dim=32,
+                   num_layers=2, hidden_dim=8)
+DCFG = D.DALLEConfig(dim=32, depth=2, vae=VCFG, num_text_tokens=50,
+                     text_seq_len=8, heads=2, dim_head=16)
+
+
+def _dalle_batch(key, b=8):
+    kt, ki = jax.random.split(key)
+    return {
+        "text": jax.random.randint(kt, (b, DCFG.text_seq_len), 0, 50),
+        "image": jax.random.randint(ki, (b, DCFG.image_seq_len), 0, 32),
+    }
+
+
+def test_dp_step_matches_single_device(key):
+    """Same global batch, dp=8 vs no mesh: identical loss and params."""
+    params = D.dalle_init(key, DCFG)
+    opt = optax.adam(1e-3)
+    loss_fn = dalle_loss_fn(DCFG)
+    batch = _dalle_batch(key)
+
+    # single-device reference
+    step1 = make_train_step(loss_fn, opt)
+    p1, s1, l1 = step1(jax.tree.map(jnp.copy, params), opt.init(params),
+                       batch, key)
+
+    mesh = make_mesh({"dp": 8})
+    p, s = setup_sharded(jax.tree.map(jnp.copy, params), opt, mesh)
+    sharded_batch = shard_batch(mesh, batch)
+    step = make_train_step(loss_fn, opt)
+    p2, s2, l2 = step(p, s, sharded_batch, key)
+
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.array(a), np.array(b), atol=1e-5), p1, p2)
+
+
+def test_tp_fsdp_sharded_step_matches_replicated(key):
+    params = D.dalle_init(key, DCFG)
+    opt = optax.adam(1e-3)
+    loss_fn = dalle_loss_fn(DCFG)
+    batch = _dalle_batch(key)
+
+    mesh = make_mesh({"dp": 2, "tp": 2, "fsdp": 2})
+    specs = dalle_param_specs(params, tp="tp", fsdp="fsdp", mesh=mesh)
+    p, s = setup_sharded(jax.tree.map(jnp.copy, params), opt, mesh, specs)
+    sharded_batch = shard_batch(mesh, batch)
+    step = make_train_step(loss_fn, opt)
+    p2, s2, l2 = step(p, s, sharded_batch, key)
+
+    step1 = make_train_step(loss_fn, opt)
+    _, _, l1 = step1(jax.tree.map(jnp.copy, params), opt.init(params),
+                     batch, key)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
+    # sharded params remain finite and correctly shaped
+    for a, b in zip(jax.tree.leaves(p2), jax.tree.leaves(params)):
+        assert a.shape == b.shape
+        assert np.isfinite(np.array(a)).all()
+
+
+def test_vae_dp_step_runs(key):
+    params = V.vae_init(key, VCFG)
+    opt = optax.adam(1e-3)
+    mesh = make_mesh({"dp": 8})
+    p, s = setup_sharded(params, opt, mesh)
+    batch = shard_batch(mesh, {
+        "images": jax.random.uniform(key, (8, 16, 16, 3), minval=-1,
+                                     maxval=1)})
+    step = make_train_step(vae_loss_fn(VCFG, smooth_l1=True), opt)
+    p, s, loss = step(p, s, batch, key)
+    assert np.isfinite(float(loss))
+
+
+def test_replicate_helper(key):
+    mesh = make_mesh({"dp": 8})
+    tree = {"a": jnp.ones((4, 4))}
+    out = replicate(mesh, tree)
+    assert out["a"].sharding.is_fully_replicated
