@@ -89,9 +89,14 @@ def dense_attention_weights(q: Array, k: Array, scale: float,
         dots = jnp.where(pair, dots, fill)
 
     if causal:
+        # -inf (not the finite pad fill): a fully-padded row then degrades
+        # to a uniform average over its CAUSAL PREFIX rather than leaking
+        # future positions — shared semantics with ops.flash_attention
+        # (deliberate fix of a reference quirk; see flash_attention module
+        # docstring).
         rows = jnp.arange(n_q)[:, None] + row0
         cols = jnp.arange(n_k)[None, :]
-        dots = jnp.where(cols <= rows, dots, fill)
+        dots = jnp.where(cols <= rows, dots, -jnp.inf)
 
     return jax.nn.softmax(dots, axis=-1)
 

@@ -1,0 +1,223 @@
+"""Block-sparse attention — Pallas TPU kernel for the VariableSparsity
+layout.
+
+The TPU-native replacement for the DeepSpeed/Triton ``SparseSelfAttention``
+the reference delegates to (reference dalle_pytorch/transformer.py:91-135;
+build recipe install_deepspeed.sh:1-3) — SURVEY.md §2a row 1.
+
+The layout is the VariableSparsityConfig default the reference constructs
+(block=16, local window of 4 blocks, global block 0, optional causal —
+ops.sparse.variable_sparsity_layout is the oracle): fully PROCEDURAL, so the
+kernel needs no mask tensors — a score tile at absolute (rows, cols) allows
+
+    (rows//W == cols//W) | (cols//block ∈ global_blocks)   [& cols <= rows]
+
+with W = num_local_blocks*block tokens. The kernel tiles at MXU size
+(128×128 by default, vs the 16-token logical block) and SKIPS every tile
+whose 128-window provably intersects no allowed block — at seq 1280 with the
+default layout that is a 13.5× FLOP cut at depth-64's sparse layers
+(per q-tile only the diagonal tile + the global tile survive).
+
+Backward: the shared blockwise scan (ops.flash_attention.
+blockwise_attention_bwd) with the layout as the structural mask. Pad-key
+masking follows the reference SparseAttention contract: KEYS only, queries
+unmasked (reference transformer.py:120-122).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from dalle_pytorch_tpu.ops.flash_attention import (FILL,
+                                                   blockwise_attention_bwd)
+
+Array = jax.Array
+
+
+def _structural(rows, cols, *, block, window, global_blocks, causal):
+    """Token-level layout mask at absolute positions (rows x cols)."""
+    same_window = (rows[:, None] // window) == (cols[None, :] // window)
+    allow = same_window
+    for g in global_blocks:
+        allow = allow | ((cols[None, :] // block) == g)
+    if causal:
+        allow = allow & (cols[None, :] <= rows[:, None])
+    return allow
+
+
+def _kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *, scale,
+            causal, block_q, block_k, seq_len, has_mask, block, window,
+            global_blocks):
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    rows = iq * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)[:, 0]
+
+    num_k = pl.cdiv(seq_len, block_k)
+    if causal:
+        num_k = jnp.minimum(num_k, pl.cdiv((iq + 1) * block_q, block_k))
+
+    w_lo_q = (iq * block_q) // window
+    w_hi_q = (iq * block_q + block_q - 1) // window
+
+    def tile_any(ik):
+        w_lo_k = (ik * block_k) // window
+        w_hi_k = (ik * block_k + block_k - 1) // window
+        overlap = (w_lo_k <= w_hi_q) & (w_lo_q <= w_hi_k)
+        for g in global_blocks:
+            tok = g * block
+            overlap = overlap | ((tok >= ik * block_k)
+                                 & (tok < (ik + 1) * block_k))
+        return overlap
+
+    def body(ik, carry):
+        def update(carry):
+            m, l, acc = carry
+            kb = k_ref[0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
+            s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            cols = ik * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)[0, :]
+            if has_mask:
+                km = mask_ref[0, pl.ds(ik * block_k, block_k)]
+                s = jnp.where(km[None, :], s, FILL)   # keys only (reference)
+            struct = _structural(rows, cols, block=block, window=window,
+                                 global_blocks=global_blocks, causal=causal)
+            if seq_len % block_k:             # ragged tail tile bounds
+                struct = struct & (cols < seq_len)[None, :]
+            s = jnp.where(struct, s, -jnp.inf)
+
+            m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+            shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.where(jnp.isfinite(s), jnp.exp(s - shift), 0.0)
+            alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
+            l = l * alpha + p.sum(axis=-1, keepdims=True)
+            acc = acc * alpha + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l, acc
+
+        return lax.cond(tile_any(ik), update, lambda c: c, carry)
+
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    a0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    m, l, acc = lax.fori_loop(0, num_k, body, (m0, l0, a0))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # (m, l) saved separately — see ops.flash_attention on lse absorption
+    m_ref[0] = jnp.where(jnp.isfinite(m), m, 0.0)[:, 0]
+    l_ref[0] = l_safe[:, 0]
+
+
+def _bs_fwd(q, k, v, mask, scale, causal, block, num_local_blocks,
+            global_blocks, block_q, block_k, interpret):
+    from dalle_pytorch_tpu.ops.flash_attention import _pad_seq
+    b, h, n_orig, d = q.shape
+    mult = max(block_q, block_k)
+    q = _pad_seq(q, mult, 2)
+    k = _pad_seq(k, mult, 2)
+    v = _pad_seq(v, mult, 2)
+    b, h, n, d = q.shape
+    bh = b * h
+    has_mask = mask is not None
+    mask_in = _pad_seq(mask, mult, 1) if has_mask else jnp.ones((b, 1), bool)
+    window = num_local_blocks * block
+
+    kernel = functools.partial(
+        _kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=n_orig, has_mask=has_mask, block=block,
+        window=window, global_blocks=global_blocks)
+
+    out, m, l = pl.pallas_call(
+        kernel,
+        grid=(bh, pl.cdiv(n, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, mask_in.shape[1]), lambda ib, iq: (ib // h, 0)),
+            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
+            pl.BlockSpec((1, n, d), lambda ib, iq: (ib, 0, 0)),
+            pl.BlockSpec((1, n, d), lambda ib, iq: (ib, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
+            pl.BlockSpec((1, block_q), lambda ib, iq: (ib, iq)),
+            pl.BlockSpec((1, block_q), lambda ib, iq: (ib, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, n), jnp.float32),
+            jax.ShapeDtypeStruct((bh, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(mask_in, q.reshape(bh, n, d), k.reshape(bh, n, d), v.reshape(bh, n, d))
+    out = out.reshape(b, h, n, d)[:, :, :n_orig]
+    m = m.reshape(b, h, n)[:, :, :n_orig]
+    l = l.reshape(b, h, n)[:, :, :n_orig]
+    return out, (m, l)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=tuple(range(4, 11)))
+def _bs(q, k, v, mask, scale, causal, block, num_local_blocks, global_blocks,
+        blocks_qk, interpret):
+    out, _ = _bs_fwd(q, k, v, mask, scale, causal, block, num_local_blocks,
+                     global_blocks, *blocks_qk, interpret)
+    return out
+
+
+def _bs_fwd_rule(q, k, v, mask, scale, causal, block, num_local_blocks,
+                 global_blocks, blocks_qk, interpret):
+    out, stats = _bs_fwd(q, k, v, mask, scale, causal, block,
+                         num_local_blocks, global_blocks, *blocks_qk,
+                         interpret)
+    return out, (q, k, v, mask, out, stats)
+
+
+def _bs_bwd_rule(scale, causal, block, num_local_blocks, global_blocks,
+                 blocks_qk, interpret, res, dout):
+    q, k, v, mask, out, stats = res
+    window = num_local_blocks * block
+
+    def structural(rows, cols):
+        return _structural(rows, cols, block=block, window=window,
+                           global_blocks=global_blocks, causal=causal)
+
+    dq, dk, dv = blockwise_attention_bwd(
+        q, k, v, mask, dout, out, stats, scale=scale,
+        block_k=min(blocks_qk[1], q.shape[2]), structural_mask_fn=structural,
+        mask_queries=False)
+    return dq, dk, dv, None
+
+
+_bs.defvjp(_bs_fwd_rule, _bs_bwd_rule)
+
+
+def block_sparse_attention(q: Array, k: Array, v: Array, *,
+                           scale: Optional[float] = None,
+                           causal: bool = True,
+                           mask: Optional[Array] = None, block: int = 16,
+                           num_local_blocks: int = 4,
+                           global_blocks: Tuple[int, ...] = (0,),
+                           block_q: int = 128, block_k: int = 128,
+                           interpret: Optional[bool] = None) -> Array:
+    """VariableSparsity block-sparse attention, Pallas forward + blockwise
+    custom_vjp backward. q/k/v: (b, h, n, d) with n a multiple of ``block``
+    (the transformer pads beforehand, reference transformer.py:112-115);
+    mask: (b, n) key-padding mask.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = q.shape[2]
+    bq, bk = min(block_q, n), min(block_k, n)
+    return _bs(q, k, v, mask, float(scale), bool(causal), int(block),
+               int(num_local_blocks), tuple(global_blocks), (bq, bk),
+               bool(interpret))

@@ -1,0 +1,277 @@
+"""Flash attention — Pallas TPU kernel, O(n) memory, exact numerics.
+
+Replaces the XLA einsum reference path (ops.attention) for the hot dense
+attention in DALLE/CLIP (the reference reaches dense attention through torch
+CUDA kernels, reference dalle_pytorch/transformer.py:51-89; this is the
+TPU-native equivalent demanded by SURVEY.md §2a).
+
+Forward: a ``pl.pallas_call`` gridded over (batch*heads, query tiles); each
+program streams key/value tiles through the MXU with the online-softmax
+recurrence — no (n, n) score matrix ever exists. Also emits the per-row
+log-sum-exp for the backward.
+
+Backward (``jax.custom_vjp``): the standard flash backward as a blockwise
+``lax.scan`` over key tiles in plain XLA — recomputes score tiles from
+(q, k, lse), accumulates dq and emits per-tile dk/dv; memory stays
+O(n · block).
+
+Masking semantics (shared with ops.attention so the two impls agree
+EXACTLY, including degenerate rows):
+
+  * pad mask (query rows AND key columns) uses a finite -fmax fill — a
+    fully-padded row degrades to a uniform average, torch masked_fill
+    behavior;
+  * the causal mask uses a true -inf fill, so that degenerate uniform
+    average runs over the CAUSAL PREFIX only. (The reference's single
+    finite fill lets fully-padded text rows attend uniformly to FUTURE
+    image positions — a quirk this rebuild deliberately fixes; flagged per
+    SURVEY.md §5 "deliberately fix" allowance. Valid rows are bit-identical
+    either way.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+Array = jax.Array
+
+FILL = -3.0e38           # finite pad fill (torch masked_fill -fmax behavior)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                seq_len: int, has_mask: bool):
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale              # (BQ, d)
+    rows = iq * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    qm = (mask_ref[0, pl.ds(iq * block_q, block_q)] if has_mask else None)
+
+    num_k = pl.cdiv(seq_len, block_k)
+    if causal:
+        num_k = jnp.minimum(num_k, pl.cdiv((iq + 1) * block_q, block_k))
+
+    def body(ik, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if has_mask:
+            km = mask_ref[0, pl.ds(ik * block_k, block_k)]
+            pad_ok = km[None, :] & qm[:, None]
+            s = jnp.where(pad_ok, s, FILL)
+        cols = ik * block_k + cols_base
+        if causal:
+            s = jnp.where(cols <= rows, s, -jnp.inf)
+        if seq_len % block_k:                 # ragged tail tile bounds
+            s = jnp.where(cols < seq_len, s, -jnp.inf)
+
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((block_q, 1), FILL, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    a0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    m, l, acc = lax.fori_loop(0, num_k, body, (m0, l0, a0))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # m and l are saved SEPARATELY: a single lse = m + log(l) loses the
+    # log(l) term entirely when m is the huge finite FILL (float absorption),
+    # corrupting the backward's softmax reconstruction at degenerate rows.
+    m_ref[0] = m[:, 0]
+    l_ref[0] = l_safe[:, 0]
+
+
+def _pad_seq(x, mult, axis):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k, interpret):
+    b, h, n_orig, d = q.shape
+    # pad to tile multiples — pl.ds CLAMPS out-of-bounds starts
+    # (dynamic_slice semantics), so ragged tails must be padded, not read
+    # past; the in-kernel seq_len bound masks the pad keys out.
+    mult = max(block_q, block_k)
+    q = _pad_seq(q, mult, 2)
+    k = _pad_seq(k, mult, 2)
+    v = _pad_seq(v, mult, 2)
+    b, h, n, d = q.shape
+    bh = b * h
+    has_mask = mask is not None
+    mask_in = _pad_seq(mask, mult, 1) if has_mask else jnp.ones((b, 1), bool)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=n_orig, has_mask=has_mask)
+
+    out, m, l = pl.pallas_call(
+        kernel,
+        grid=(bh, pl.cdiv(n, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, mask_in.shape[1]), lambda ib, iq: (ib // h, 0)),
+            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
+            pl.BlockSpec((1, n, d), lambda ib, iq: (ib, 0, 0)),
+            pl.BlockSpec((1, n, d), lambda ib, iq: (ib, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
+            pl.BlockSpec((1, block_q), lambda ib, iq: (ib, iq)),
+            pl.BlockSpec((1, block_q), lambda ib, iq: (ib, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, n), jnp.float32),
+            jax.ShapeDtypeStruct((bh, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(mask_in, q.reshape(bh, n, d), k.reshape(bh, n, d), v.reshape(bh, n, d))
+    out = out.reshape(b, h, n, d)[:, :, :n_orig]
+    m = m.reshape(b, h, n)[:, :, :n_orig]
+    l = l.reshape(b, h, n)[:, :, :n_orig]
+    return out, (m, l)
+
+
+# ---------------------------------------------------------------------------
+# blockwise backward (shared with ops.block_sparse)
+# ---------------------------------------------------------------------------
+
+def blockwise_attention_bwd(q, k, v, mask, dout, out, softmax_stats, *,
+                            scale: float, block_k: int, structural_mask_fn,
+                            mask_queries: bool = True):
+    """Flash backward as a lax.scan over key tiles; never materializes (n,n).
+
+    ``softmax_stats`` is the forward's (m, l) pair — kept separate rather
+    than fused into lse = m + log(l) so degenerate rows (m == FILL)
+    reconstruct exactly. ``structural_mask_fn(rows, cols) -> (n, BK) bool``
+    gives the -inf structural mask (causal and/or sparsity layout); the pad
+    ``mask`` (b, n) applies with the finite FILL to key columns (and query
+    rows when ``mask_queries``) — exactly mirroring the forward.
+    """
+    m_stat, l_stat = softmax_stats
+    inv_l = 1.0 / l_stat
+    b, h, n, d = q.shape
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    doutf = dout.astype(jnp.float32)
+    D = jnp.sum(doutf * out.astype(jnp.float32), axis=-1)        # (b, h, n)
+    rows = jnp.arange(n)
+
+    assert n % block_k == 0, "sequence must divide the backward block"
+    num_k = n // block_k
+
+    def step(dq, ik):
+        ks = lax.dynamic_slice_in_dim(kf, ik * block_k, block_k, axis=2)
+        vs = lax.dynamic_slice_in_dim(vf, ik * block_k, block_k, axis=2)
+        cols = ik * block_k + jnp.arange(block_k)
+
+        s = jnp.einsum("bhid,bhjd->bhij", qf, ks) * scale
+        live = None                           # entries whose s is not a
+        if mask is not None:                  # constant fill substitution
+            km = lax.dynamic_slice_in_dim(mask, ik * block_k, block_k,
+                                          axis=1)
+            pad_ok = km[:, None, :]
+            if mask_queries:
+                pad_ok = pad_ok & mask[:, :, None]
+            s = jnp.where(pad_ok[:, None], s, FILL)
+            live = pad_ok[:, None]
+        struct = structural_mask_fn(rows, cols)
+        if struct is not None:
+            s = jnp.where(struct[None, None], s, -jnp.inf)
+
+        p = jnp.exp(s - m_stat[..., None]) * inv_l[..., None]  # (b,h,n,BK)
+        dv = jnp.einsum("bhij,bhid->bhjd", p, doutf)
+        dp = jnp.einsum("bhid,bhjd->bhij", doutf, vs)
+        ds = p * (dp - D[..., None]) * scale
+        # where s was REPLACED by the fill, no gradient reaches q·k (the
+        # forward's jnp.where blocks it) — p still feeds dv, but ds is 0.
+        if live is not None:
+            ds = jnp.where(live, ds, 0.0)
+        dk = jnp.einsum("bhij,bhid->bhjd", ds, qf)
+        dq = dq + jnp.einsum("bhij,bhjd->bhid", ds, ks)
+        return dq, (dk, dv)
+
+    dq, (dks, dvs) = lax.scan(step, jnp.zeros_like(qf), jnp.arange(num_k))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, n, d)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, n, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing + public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, mask, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k,
+                        interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, mask, scale, causal, block_q, block_k,
+                    interpret):
+    out, stats = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k,
+                            interpret)
+    return out, (q, k, v, mask, out, stats)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, dout):
+    q, k, v, mask, out, stats = res
+
+    def structural(rows, cols):
+        if not causal:
+            return None
+        return cols[None, :] <= rows[:, None]
+
+    dq, dk, dv = blockwise_attention_bwd(
+        q, k, v, mask, dout, out, stats, scale=scale,
+        block_k=min(block_k, q.shape[2]), structural_mask_fn=structural)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q: Array, k: Array, v: Array, *,
+                    scale: Optional[float] = None, causal: bool = True,
+                    mask: Optional[Array] = None, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None) -> Array:
+    """Exact attention, Pallas forward + blockwise custom_vjp backward.
+
+    q/k/v: (b, h, n, d); mask: (b, n) True=keep. ``interpret=None``
+    auto-selects the Pallas interpreter off-TPU so the same code path runs
+    on the CPU test mesh.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = q.shape[2]
+    return _flash(q, k, v, mask, float(scale), bool(causal),
+                  min(block_q, n), min(block_k, n), bool(interpret))

@@ -84,20 +84,21 @@ def sparse_attention_ref(q: Array, k: Array, v: Array, *, scale: float,
     """
     b, h, n, d = q.shape
     dots = jnp.einsum("bhid,bhjd->bhij", q, k) * scale
-    fill = core.neg_inf(dots.dtype)
 
+    # two-fill semantics shared with the Pallas kernels (see
+    # ops.flash_attention docstring): structural masks (layout + causal)
+    # are -inf, pad keys are the finite fill.
     layout = jnp.asarray(token_layout_mask(
         n, block, num_local_blocks=num_local_blocks,
         global_blocks=global_blocks, causal=causal))
-    allowed = layout[None, None, :, :]
-
+    structural = layout[None, None, :, :]
     if causal:
         tri = jnp.tril(jnp.ones((n, n), bool))
-        allowed = allowed & tri[None, None, :, :]
+        structural = structural & tri[None, None, :, :]
 
     if mask is not None:
-        allowed = allowed & mask[:, None, None, :]  # key padding only
-
-    dots = jnp.where(allowed, dots, fill)
+        dots = jnp.where(mask[:, None, None, :], dots,
+                         core.neg_inf(dots.dtype))  # key padding only
+    dots = jnp.where(structural, dots, -jnp.inf)
     attn = jax.nn.softmax(dots, axis=-1)
     return jnp.einsum("bhij,bhjd->bhid", attn, v)

@@ -51,7 +51,13 @@ def test_pad_mask_blocks_keys(key):
 
 def test_matches_torch_reference(key):
     """Bit-level semantics vs a torch reimplementation of the reference
-    Attention.forward on the same weights."""
+    Attention.forward on the same weights.
+
+    One documented deviation (see ops.flash_attention docstring): the causal
+    mask uses -inf rather than the finite -fmax, so FULLY-PADDED rows
+    average over their causal prefix instead of leaking future positions.
+    The torch path below mirrors that (float('-inf') for the causal fill);
+    valid rows are unaffected either way."""
     torch = pytest.importorskip("torch")
     dim, heads, dim_head, n, b = 16, 2, 8, 12, 2
     params = A.attention_init(key, dim, heads, dim_head)
@@ -79,7 +85,7 @@ def test_matches_torch_reference(key):
     pair = mt[:, None, :, None] * mt[:, None, None, :]
     dots.masked_fill_(~pair, mask_value)
     causal = torch.ones(n, n).triu_(1).bool()
-    dots.masked_fill_(causal, mask_value)
+    dots.masked_fill_(causal, float("-inf"))
     attn = dots.softmax(dim=-1)
     out = torch.einsum("bhij,bhjd->bhid", attn, v)
     out = out.transpose(1, 2).reshape(b, n, heads * dim_head)
