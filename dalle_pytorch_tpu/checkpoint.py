@@ -1,0 +1,191 @@
+"""Checkpoint/resume subsystem — params, optimizer state, and run metadata.
+
+The reference persists bare ``state_dict`` weights once per epoch
+(reference trainVAE.py:119, trainDALLE.py:212) and resumes with
+``torch.load``+``load_state_dict`` (reference trainVAE.py:52-54,
+trainDALLE.py:64-67,84-86, genDALLE.py:51-52,70-71, mixVAEcuda.py:20-21).
+Optimizer state is NOT saved there — this build improves on that (SURVEY.md
+§5.4) while keeping the same cross-program contract: ``train_vae`` writes a
+checkpoint that ``train_dalle`` / ``gen_dalle`` / ``mix_vae`` read.
+
+Format (a directory per step/epoch, atomic-rename commit):
+
+    {dir}/{name}-{epoch}/
+        manifest.json      # kind, epoch/step, model config as plain dict,
+                           # extra metadata (temperature schedule state, ...)
+        params.msgpack     # flax msgpack of the param pytree (bf16-safe)
+        opt_state.msgpack  # optional; restored against optimizer.init(params)
+
+Pytree leaves round-trip through ``flax.serialization`` msgpack (handles
+dict/list/tuple trees of numpy/jax arrays including bfloat16). Restore pulls
+arrays to host numpy; callers ``device_put``/shard as needed — checkpoints
+stay layout-agnostic so a single-chip checkpoint restores onto any mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import shutil
+import tempfile
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+MANIFEST = "manifest.json"
+PARAMS = "params.msgpack"
+OPT_STATE = "opt_state.msgpack"
+
+
+def _to_host(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def _config_dict(config: Any) -> Any:
+    """Dataclass config -> JSON-safe dict (recursively, so VAEConfig nested
+    in DALLEConfig survives)."""
+    if dataclasses.is_dataclass(config) and not isinstance(config, type):
+        return {f.name: _config_dict(getattr(config, f.name))
+                for f in dataclasses.fields(config)}
+    if isinstance(config, (list, tuple)):
+        return list(_config_dict(c) for c in config)
+    return config
+
+
+def save(path: str, params, *, step: int = 0, config: Any = None,
+         opt_state=None, kind: str = "model", meta: Optional[dict] = None
+         ) -> str:
+    """Write a checkpoint directory atomically (tmp dir + rename), so a
+    killed writer never leaves a half-checkpoint that resume would trust."""
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=parent, prefix=".ckpt-tmp-")
+    try:
+        manifest = {
+            "kind": kind,
+            "step": int(step),
+            "config": _config_dict(config) if config is not None else None,
+            "meta": meta or {},
+            "format": 1,
+        }
+        with open(os.path.join(tmp, MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1)
+        with open(os.path.join(tmp, PARAMS), "wb") as f:
+            f.write(serialization.msgpack_serialize(_to_host(params)))
+        if opt_state is not None:
+            with open(os.path.join(tmp, OPT_STATE), "wb") as f:
+                f.write(serialization.to_bytes(_to_host(opt_state)))
+        # swap in with no window where neither old nor new exists: move the
+        # old checkpoint aside, rename the new one in, then delete the old
+        old = None
+        if os.path.isdir(path):
+            old = tempfile.mkdtemp(dir=parent, prefix=".ckpt-old-")
+            os.rmdir(old)
+            os.replace(path, old)
+        os.replace(tmp, path)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return path
+
+
+def load_manifest(path: str) -> dict:
+    with open(os.path.join(path, MANIFEST)) as f:
+        return json.load(f)
+
+
+def restore(path: str, opt_target=None) -> Tuple[Any, Any, dict]:
+    """-> (params, opt_state | None, manifest).
+
+    ``opt_target`` (usually ``optimizer.init(params)``) gives the structure
+    the optimizer-state bytes restore into; None skips opt state even if the
+    file exists.
+    """
+    manifest = load_manifest(path)
+    with open(os.path.join(path, PARAMS), "rb") as f:
+        params = serialization.msgpack_restore(f.read())
+    opt_state = None
+    opt_file = os.path.join(path, OPT_STATE)
+    if opt_target is not None:
+        if not os.path.exists(opt_file):
+            raise FileNotFoundError(
+                f"checkpoint {path} has no optimizer state to restore")
+        with open(opt_file, "rb") as f:
+            opt_state = serialization.from_bytes(opt_target, f.read())
+    return params, opt_state, manifest
+
+
+def restore_params(path: str) -> Tuple[Any, dict]:
+    params, _, manifest = restore(path)
+    return params, manifest
+
+
+def restore_train(path: str, optimizer) -> Tuple[Any, Any, dict]:
+    """-> (params, opt_state | None, manifest) with ONE params read: the
+    optimizer-state target is built from the just-restored params, and the
+    opt file is decoded directly (no second restore() pass). opt_state is
+    None when the checkpoint has no optimizer state (weights-only)."""
+    manifest = load_manifest(path)
+    with open(os.path.join(path, PARAMS), "rb") as f:
+        params = serialization.msgpack_restore(f.read())
+    opt_state = None
+    opt_file = os.path.join(path, OPT_STATE)
+    if os.path.exists(opt_file):
+        with open(opt_file, "rb") as f:
+            opt_state = serialization.from_bytes(optimizer.init(params),
+                                                 f.read())
+    return params, opt_state, manifest
+
+
+# ---------------------------------------------------------------------------
+# epoch-templated naming — the cross-CLI contract
+# ---------------------------------------------------------------------------
+
+def ckpt_path(models_dir: str, name: str, epoch: int) -> str:
+    """``{models_dir}/{name}-{epoch}`` — the name-and-epoch template every
+    CLI shares (reference trainVAE.py:119 writes ``{name}-{epoch}.pth``;
+    trainDALLE.py:66 reads the same)."""
+    return os.path.join(models_dir, f"{name}-{epoch}")
+
+
+def latest(models_dir: str, name: str) -> Optional[Tuple[str, int]]:
+    """Newest (path, epoch) for ``name`` under ``models_dir``, or None —
+    resume-after-kill without remembering the epoch number."""
+    if not os.path.isdir(models_dir):
+        return None
+    pat = re.compile(re.escape(name) + r"-(\d+)$")
+    best = None
+    for entry in os.listdir(models_dir):
+        m = pat.match(entry)
+        full = os.path.join(models_dir, entry)
+        if m and os.path.isdir(full) and \
+                os.path.exists(os.path.join(full, MANIFEST)):
+            epoch = int(m.group(1))
+            if best is None or epoch > best[1]:
+                best = (full, epoch)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# config reconstruction
+# ---------------------------------------------------------------------------
+
+def vae_config_from_manifest(manifest: dict):
+    from dalle_pytorch_tpu.models.vae import VAEConfig
+    return VAEConfig(**manifest["config"])
+
+
+def dalle_config_from_manifest(manifest: dict):
+    from dalle_pytorch_tpu.models.dalle import DALLEConfig
+    from dalle_pytorch_tpu.models.vae import VAEConfig
+    cfg = dict(manifest["config"])
+    cfg["vae"] = VAEConfig(**cfg["vae"])
+    if isinstance(cfg.get("sparse_attn"), list):
+        cfg["sparse_attn"] = tuple(cfg["sparse_attn"])
+    return DALLEConfig(**cfg)

@@ -1,0 +1,8 @@
+"""L6 driver CLIs: train_vae, train_dalle, gen_dalle, mix_vae.
+
+TPU-native rebuilds of the reference scripts (trainVAE.py, trainDALLE.py,
+genDALLE.py, mixVAEcuda.py): same flag surface and artifacts, but jit train
+steps over a device mesh, prefetched host IO, KV-cache sampling, and
+checkpoints with optimizer state. Run as modules, e.g.
+``python -m dalle_pytorch_tpu.cli.train_vae --help``.
+"""

@@ -1,0 +1,84 @@
+"""Shared CLI plumbing: common flags, device/mesh setup, seeding.
+
+The reference scripts configure everything through per-script argparse
+(SURVEY.md §5.6); these helpers keep the rebuilt CLIs' flag surface
+consistent (same names as the reference where one exists: --batchSize,
+--dataPath, --imageSize, --n_epochs, --lr, --name, --start_epoch) and add
+the TPU-era flags (--dp mesh, --profile_dir, --nan_checks, --metrics).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import numpy as np
+
+from dalle_pytorch_tpu import checkpoint as ckpt
+from dalle_pytorch_tpu.parallel import make_mesh
+from dalle_pytorch_tpu.utils import MetricsLogger, StepProfiler, \
+    enable_nan_checks
+
+
+def resolve_resume(name_or_path: str, models_dir: str, start_epoch: int):
+    """Resolve a --loadVAE/--load_dalle value to (checkpoint path,
+    start_epoch). A directory path is used as-is; a name with
+    ``start_epoch > 0`` maps to ``{models_dir}/{name}-{start_epoch-1}``
+    (the reference's explicit-epoch resume, trainVAE.py:20-21); a bare name
+    with no start_epoch resumes from the NEWEST checkpoint."""
+    if os.path.isdir(name_or_path):
+        return name_or_path, start_epoch
+    if start_epoch > 0:
+        return ckpt.ckpt_path(models_dir, name_or_path,
+                              start_epoch - 1), start_epoch
+    found = ckpt.latest(models_dir, name_or_path)
+    if found is None:
+        raise FileNotFoundError(
+            f"no checkpoint named {name_or_path!r} under {models_dir!r} "
+            "(give --start_epoch to pick a specific epoch)")
+    path, epoch = found
+    return path, epoch + 1
+
+
+def add_common_args(parser: argparse.ArgumentParser,
+                    default_batch: int = 24) -> None:
+    parser.add_argument("--batchSize", type=int, default=default_batch,
+                        help=f"global batch size (default: {default_batch})")
+    parser.add_argument("--n_epochs", type=int, default=500,
+                        help="number of epochs (default: 500)")
+    parser.add_argument("--lr", type=float, default=1e-4,
+                        help="learning rate (default: 1e-4)")
+    parser.add_argument("--name", type=str, default=None,
+                        help="experiment name")
+    parser.add_argument("--start_epoch", type=int, default=0,
+                        help="start epoch numbering when resuming")
+    parser.add_argument("--models_dir", type=str, default="./models",
+                        help="checkpoint directory (default: ./models)")
+    parser.add_argument("--results_dir", type=str, default="./results",
+                        help="sample/recon image directory")
+    parser.add_argument("--log_interval", type=int, default=10)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--dp", type=int, default=0,
+                        help="data-parallel devices (0 = all available)")
+    parser.add_argument("--profile_dir", type=str, default="",
+                        help="capture a jax.profiler trace here")
+    parser.add_argument("--nan_checks", action="store_true",
+                        help="enable jax NaN/Inf trapping (slow)")
+    parser.add_argument("--metrics", type=str, default="",
+                        help="JSONL metrics file path")
+
+
+def setup_run(args, unit_name: str = "tokens"):
+    """-> (mesh, MetricsLogger, StepProfiler). Applies NaN toggles/seeding."""
+    if args.nan_checks:
+        enable_nan_checks(True)
+    np.random.seed(args.seed)
+    n = args.dp or len(jax.devices())
+    mesh = make_mesh({"dp": n}, jax.devices()[:n])
+    metrics = MetricsLogger(args.metrics or None,
+                            log_interval=args.log_interval, n_devices=n)
+    profiler = StepProfiler(args.profile_dir or None)
+    os.makedirs(args.models_dir, exist_ok=True)
+    os.makedirs(args.results_dir, exist_ok=True)
+    return mesh, metrics, profiler

@@ -1,0 +1,134 @@
+"""Text -> image generation CLI — the reference genDALLE.py, TPU-native.
+
+Capability parity (reference genDALLE.py:1-113): rebuilds the training
+vocabulary (from the saved vocab JSON train_dalle writes, or by re-reading
+the captions-only corpus exactly as the reference does, :77-93), tokenizes
+the caption, and — deliberately preserving the reference's quirk — passes
+the UNPADDED token list (reference :106 uses ``codes``, not the padded
+``c_tokens``), so the model first autoregressively completes the remaining
+text positions, then the image tokens. OOV words KeyError, the reference's
+documented failure mode (Vocabulary.py:43, SURVEY.md §5.3). Output is a
+timestamped PNG grid (reference :109-112).
+
+TPU-first: generation is the jit ``lax.scan`` KV-cache sampler — one
+compiled program for all 1024+ steps instead of full re-forwards; optional
+CLIP rerank scores the batch and orders the grid best-first (reference
+dalle_pytorch.py:354-356).
+
+Run: python -m dalle_pytorch_tpu.cli.gen_dalle "a caption" --name test \
+        --dalle_epoch 99 --vaename vae --vae_epoch 99
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_pytorch_tpu import checkpoint as ckpt
+from dalle_pytorch_tpu.data import (Vocabulary, read_captions_only,
+                                    save_image_grid)
+from dalle_pytorch_tpu.models import dalle as D
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="generate images from text (TPU-native DALLE-pytorch)")
+    p.add_argument("caption", type=str, help="input text")
+    p.add_argument("--name", type=str, default="test",
+                   help="DALLE experiment name (as given to train_dalle)")
+    p.add_argument("--dalle_epoch", type=int, default=0)
+    p.add_argument("--models_dir", type=str, default="./models")
+    p.add_argument("--results_dir", type=str, default="./results")
+    p.add_argument("--vocab", type=str, default="",
+                   help="vocab JSON (default: {models_dir}/{name}-vocab.json)")
+    p.add_argument("--captions_only", type=str, default="",
+                   help="rebuild vocab from this corpus instead")
+    p.add_argument("--num_images", type=int, default=1,
+                   help="images to sample for the caption")
+    p.add_argument("--filter_thres", type=float, default=0.5)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--pad_prompt", action="store_true",
+                   help="pad the prompt to text_seq_len instead of the "
+                        "reference's unpadded text-completion mode")
+    p.add_argument("--clip_name", type=str, default="",
+                   help="CLIP checkpoint name for reranking")
+    p.add_argument("--clip_epoch", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def load_vocab(args) -> Vocabulary:
+    if args.captions_only:
+        return Vocabulary.from_captions(read_captions_only(
+            args.captions_only))
+    path = args.vocab or os.path.join(args.models_dir,
+                                      f"{args.name}-vocab.json")
+    return Vocabulary.load(path)
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    dalle_path = ckpt.ckpt_path(args.models_dir, f"{args.name}_dalle",
+                                args.dalle_epoch)
+    params, manifest = ckpt.restore_params(dalle_path)
+    cfg = ckpt.dalle_config_from_manifest(manifest)
+    vae_path = manifest["meta"].get("vae_checkpoint")
+    if not vae_path or not os.path.isdir(vae_path):
+        raise FileNotFoundError(
+            f"DALLE checkpoint {dalle_path} does not point at a VAE "
+            "checkpoint (meta.vae_checkpoint)")
+    vae_params, _ = ckpt.restore_params(vae_path)
+    # restored trees are host numpy; the scan sampler indexes tables with
+    # traced positions, which needs device arrays
+    params = jax.device_put(params)
+    vae_params = jax.device_put(vae_params)
+
+    vocab = load_vocab(args)
+    print(args.caption)
+    codes = vocab.encode(args.caption,
+                         pad_to=cfg.text_seq_len if args.pad_prompt
+                         else None)
+    print(codes)
+
+    text = jnp.asarray([codes] * args.num_images, jnp.int32)
+
+    clip_kwargs = {}
+    if args.clip_name:
+        clip_path = ckpt.ckpt_path(args.models_dir, args.clip_name,
+                                   args.clip_epoch)
+        clip_params, clip_manifest = ckpt.restore_params(clip_path)
+        from dalle_pytorch_tpu.models.clip import CLIPConfig
+        clip_kwargs = {"clip_params": clip_params,
+                       "clip_cfg": CLIPConfig(**clip_manifest["config"])}
+
+    out = D.generate_images(
+        params, vae_params, text, cfg=cfg,
+        rng=jax.random.PRNGKey(args.seed),
+        filter_thres=args.filter_thres, temperature=args.temperature,
+        **clip_kwargs)
+
+    if clip_kwargs:
+        images, scores = out
+        order = np.argsort(-np.asarray(scores))    # best first
+        images = np.asarray(images)[order]
+        print("clip scores (sorted):", np.asarray(scores)[order])
+    else:
+        images = np.asarray(out)
+
+    ts = int(time.time())
+    print(args.caption, ts)
+    path = os.path.join(
+        args.results_dir,
+        f"gendalle{args.name}_epoch_{args.dalle_epoch}-{ts}.png")
+    save_image_grid(images, path, nrow=min(args.num_images, 8))
+    print(f"saved {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""L5 data layer: vocabulary, caption parsing, image IO, prefetch.
+
+TPU-native replacement for the reference's data stack (Vocabulary.py,
+trainDALLE.py:92-163 caption pipeline, torchvision ImageFolder/read_image) —
+NHWC numpy on the host, background prefetch onto the device mesh.
+"""
+
+from dalle_pytorch_tpu.data.captions import (CaptionDataset, encode_pairs,
+                                             load_caption_data,
+                                             read_caption_pairs,
+                                             read_captions_only, text_mask)
+from dalle_pytorch_tpu.data.images import (ImageFolderDataset, load_image,
+                                           load_image_batch,
+                                           list_image_folder,
+                                           save_image_grid, to_uint8)
+from dalle_pytorch_tpu.data.prefetch import Prefetcher, prefetch, \
+    shard_for_host
+from dalle_pytorch_tpu.data.vocabulary import (EOS_TOKEN, PAD_TOKEN,
+                                               SOS_TOKEN, Vocabulary)
+
+__all__ = [
+    "Vocabulary", "PAD_TOKEN", "SOS_TOKEN", "EOS_TOKEN",
+    "CaptionDataset", "load_caption_data", "read_caption_pairs",
+    "read_captions_only", "encode_pairs", "text_mask",
+    "ImageFolderDataset", "load_image", "load_image_batch",
+    "list_image_folder", "save_image_grid", "to_uint8",
+    "Prefetcher", "prefetch", "shard_for_host",
+]

@@ -1,0 +1,145 @@
+"""Image IO: load/normalize images for training, save sample grids.
+
+Replaces the reference's torchvision calls with PIL + numpy, producing NHWC
+float32 — the layout the whole framework runs in (torch/torchvision are NCHW):
+
+* ``load_image`` — read + resize + scale to [0,1] + normalize to [-1,1]
+  (reference trainVAE.py:59-63 transform stack and trainDALLE.py:185-187
+  ``read_image(...)/255.`` + Normalize(0.5, 0.5)).
+* ``load_image_batch`` — the per-path minibatch fetch loop
+  (reference trainDALLE.py:180-188), vectorized into one NHWC array.
+* ``save_image_grid`` — row-major tiling + renormalization to PNG, the
+  ``torchvision.utils.save_image(..., normalize=True)`` equivalent used for
+  recon grids and samples (reference trainVAE.py:109-114,
+  trainDALLE.py:215-217, mixVAEcuda.py:48-55).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+try:
+    from PIL import Image
+except ImportError:  # pragma: no cover - PIL is in the base image
+    Image = None
+
+
+def _require_pil():
+    if Image is None:
+        raise ImportError("PIL is required for image IO")
+
+
+def load_image(path: str, image_size: Optional[int] = None) -> np.ndarray:
+    """-> (H, W, 3) float32 in [-1, 1]."""
+    _require_pil()
+    img = Image.open(path).convert("RGB")
+    if image_size is not None and img.size != (image_size, image_size):
+        img = img.resize((image_size, image_size), Image.BILINEAR)
+    arr = np.asarray(img, dtype=np.float32) / 255.0
+    return arr * 2.0 - 1.0
+
+
+def load_image_batch(paths: Sequence[str], data_path: str = "",
+                     image_size: Optional[int] = None,
+                     subdir: str = "0") -> np.ndarray:
+    """Fetch a minibatch of images by filename -> (b, H, W, 3) in [-1, 1].
+
+    Filenames resolve under ``{data_path}/{subdir}/{filename}`` — the
+    reference's ImageFolder-style single-class layout (reference
+    trainDALLE.py:185 'images are expected to be in ./imagefolder/0/').
+    Absolute paths and paths that already exist are used as-is.
+    """
+    out = []
+    for p in paths:
+        full = p
+        if not os.path.isabs(p) and not os.path.exists(p):
+            full = os.path.join(data_path, subdir, p)
+        out.append(load_image(full, image_size))
+    return np.stack(out)
+
+
+def list_image_folder(root: str) -> List[str]:
+    """All image files under an ImageFolder-style root (class subdirs, or a
+    flat dir), sorted — the torchvision ``datasets.ImageFolder`` file walk
+    (reference trainVAE.py:65-67) without the unused class labels."""
+    exts = {".png", ".jpg", ".jpeg", ".bmp", ".webp"}
+    files = []
+    for dirpath, _, names in os.walk(root):
+        for n in sorted(names):
+            if os.path.splitext(n)[1].lower() in exts:
+                files.append(os.path.join(dirpath, n))
+    return sorted(files)
+
+
+class ImageFolderDataset:
+    """Minimal ImageFolder: fixed-size shuffled batches of normalized NHWC
+    images (reference trainVAE.py:59-67 DataLoader over ImageFolder)."""
+
+    def __init__(self, root: str, image_size: int, batch_size: int,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = True):
+        self.files = list_image_folder(root)
+        if not self.files:
+            raise FileNotFoundError(f"no images under {root!r}")
+        self.image_size = image_size
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+
+    def __len__(self) -> int:
+        n = len(self.files)
+        if self.drop_last and n >= self.batch_size:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def epoch(self, epoch: int = 0):
+        order = np.arange(len(self.files))
+        if self.shuffle:
+            np.random.default_rng((self.seed, epoch)).shuffle(order)
+        for b in range(len(self)):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            if len(idx) < self.batch_size:  # wrap ragged tail
+                idx = np.concatenate([idx, order[:self.batch_size - len(idx)]])
+            yield np.stack([load_image(self.files[i], self.image_size)
+                            for i in idx])
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
+def to_uint8(images: np.ndarray, normalize: bool = True) -> np.ndarray:
+    """(..., H, W, C) float -> uint8. ``normalize=True`` rescales by the
+    batch min/max like torchvision save_image(normalize=True); otherwise
+    assumes [-1, 1]."""
+    x = np.asarray(images, dtype=np.float32)
+    if normalize:
+        lo, hi = float(x.min()), float(x.max())
+        x = (x - lo) / max(hi - lo, 1e-8)
+    else:
+        x = (x + 1.0) / 2.0
+    return (np.clip(x, 0.0, 1.0) * 255.0 + 0.5).astype(np.uint8)
+
+
+def save_image_grid(images: np.ndarray, path: str, nrow: int = 8,
+                    normalize: bool = True, padding: int = 2) -> None:
+    """Tile (b, H, W, C) into a row-major grid PNG — the save_image
+    equivalent for recon grids and samples."""
+    _require_pil()
+    x = to_uint8(images, normalize=normalize)
+    b, h, w, c = x.shape
+    ncol = min(nrow, b)
+    nrows = math.ceil(b / ncol)
+    grid = np.zeros((nrows * (h + padding) + padding,
+                     ncol * (w + padding) + padding, c), np.uint8)
+    for i in range(b):
+        r, col = divmod(i, ncol)
+        y0 = r * (h + padding) + padding
+        x0 = col * (w + padding) + padding
+        grid[y0:y0 + h, x0:x0 + w] = x[i]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    Image.fromarray(grid.squeeze() if c == 1 else grid).save(path)

@@ -1,0 +1,96 @@
+"""Host-side prefetching and host-sharded data feeding.
+
+The reference reads every image synchronously inside the train loop
+(reference trainDALLE.py:182-187) — a host-bound stall between every step.
+SURVEY.md §7 (hard part e) requires the TPU pipeline to overlap host IO with
+device compute instead:
+
+* ``Prefetcher`` — a daemon-thread pipeline that stays ``depth`` batches
+  ahead of the consumer, moving each batch to device (optionally with a
+  ``NamedSharding``) so the next step's inputs are already resident when the
+  current step retires. With jax's async dispatch this keeps the chip fed as
+  long as host IO for one batch is faster than one train step.
+* ``shard_for_host`` — multi-host data sharding: each process takes its
+  contiguous slice of the example list, so a v5e-64-style multi-host job
+  reads 1/num_hosts of the data per host (the standard jax.process_index
+  recipe; collectives then see a globally-sharded batch).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import jax
+
+
+def shard_for_host(items: Sequence[Any],
+                   process_index: Optional[int] = None,
+                   process_count: Optional[int] = None) -> Sequence[Any]:
+    """Contiguous per-host slice of a dataset (equal-length across hosts,
+    trailing remainder dropped so every host steps in lockstep)."""
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    per = len(items) // pc
+    if per == 0:
+        raise ValueError(f"{len(items)} items cannot feed {pc} hosts")
+    return items[pi * per:(pi + 1) * per]
+
+
+class Prefetcher:
+    """Wraps a host batch iterator; yields device-resident batches.
+
+    ``transform`` runs in the worker thread (e.g. the per-batch image file
+    reads), so disk + decode overlap device compute. ``sharding`` device_puts
+    each batch with a NamedSharding (global array for pjit); None leaves the
+    put to jit's default device placement.
+    """
+
+    _DONE = object()
+
+    def __init__(self, it: Iterable, depth: int = 2,
+                 transform: Optional[Callable[[Any], Any]] = None,
+                 sharding=None):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._err: Optional[BaseException] = None
+        self._transform = transform
+        self._sharding = sharding
+        self._thread = threading.Thread(
+            target=self._worker, args=(iter(it),), daemon=True)
+        self._thread.start()
+
+    def _worker(self, it: Iterator):
+        try:
+            for batch in it:
+                if self._transform is not None:
+                    batch = self._transform(batch)
+                if self._sharding is not None:
+                    batch = jax.tree.map(
+                        lambda x: jax.device_put(x, self._sharding), batch)
+                else:
+                    batch = jax.tree.map(jax.device_put, batch)
+                self._q.put(batch)
+        except BaseException as e:  # surfaced on the consumer side
+            self._err = e
+        finally:
+            self._q.put(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._DONE:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+
+def prefetch(it: Iterable, depth: int = 2,
+             transform: Optional[Callable[[Any], Any]] = None,
+             sharding=None) -> Prefetcher:
+    """Convenience wrapper: ``for batch in prefetch(dataset.epoch(e)): ...``"""
+    return Prefetcher(it, depth=depth, transform=transform,
+                      sharding=sharding)

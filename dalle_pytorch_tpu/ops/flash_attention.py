@@ -173,6 +173,21 @@ def blockwise_attention_bwd(q, k, v, mask, dout, out, softmax_stats, *,
     rows when ``mask_queries``) — exactly mirroring the forward.
     """
     m_stat, l_stat = softmax_stats
+    b, h, n_orig, d = q.shape
+    # ragged sequences: pad everything to a block_k multiple (mirroring the
+    # forward's _pad_seq) and mask padded KEY columns structurally below;
+    # padded QUERY rows contribute nothing because dout/out/D are zero there
+    # and m=0/l=1 keep p finite. Gradients are sliced back to n_orig.
+    ragged = n_orig % block_k != 0
+    if ragged:
+        q, k, v, dout, out = (_pad_seq(x, block_k, 2)
+                              for x in (q, k, v, dout, out))
+        m_stat = _pad_seq(m_stat, block_k, 2)
+        l_stat = _pad_seq(l_stat, block_k, 2)
+        l_stat = jnp.where(jnp.arange(l_stat.shape[-1]) < n_orig,
+                           l_stat, 1.0)                  # keep 1/l finite
+        if mask is not None:
+            mask = _pad_seq(mask, block_k, 1)
     inv_l = 1.0 / l_stat
     b, h, n, d = q.shape
     qf = q.astype(jnp.float32)
@@ -182,7 +197,6 @@ def blockwise_attention_bwd(q, k, v, mask, dout, out, softmax_stats, *,
     D = jnp.sum(doutf * out.astype(jnp.float32), axis=-1)        # (b, h, n)
     rows = jnp.arange(n)
 
-    assert n % block_k == 0, "sequence must divide the backward block"
     num_k = n // block_k
 
     def step(dq, ik):
@@ -201,6 +215,9 @@ def blockwise_attention_bwd(q, k, v, mask, dout, out, softmax_stats, *,
             s = jnp.where(pad_ok[:, None], s, FILL)
             live = pad_ok[:, None]
         struct = structural_mask_fn(rows, cols)
+        if ragged:
+            bound = (cols < n_orig)[None, :]   # padded keys out, all rows
+            struct = bound if struct is None else struct & bound
         if struct is not None:
             s = jnp.where(struct[None, None], s, -jnp.inf)
 
@@ -219,6 +236,8 @@ def blockwise_attention_bwd(q, k, v, mask, dout, out, softmax_stats, *,
     dq, (dks, dvs) = lax.scan(step, jnp.zeros_like(qf), jnp.arange(num_k))
     dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, n, d)
     dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, n, d)
+    if ragged:
+        dq, dk, dv = (x[:, :, :n_orig] for x in (dq, dk, dv))
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

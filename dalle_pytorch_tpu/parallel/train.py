@@ -45,19 +45,35 @@ def make_train_step(loss_fn: Callable, optimizer) -> Callable:
     return step
 
 
-def setup_sharded(params, optimizer, mesh: Mesh, param_specs=None):
+def setup_sharded(params, optimizer, mesh: Mesh, param_specs=None,
+                  opt_state=None):
     """Place params per ``param_specs`` (replicated when None) and build the
     optimizer state THROUGH jit so its moment buffers inherit the param
-    shardings (the standard GSPMD propagation trick)."""
+    shardings (the standard GSPMD propagation trick). A restored
+    ``opt_state`` (checkpoint resume) is placed like the params instead of
+    re-initialized."""
     if param_specs is None:
         shardings = NamedSharding(mesh, P())
         params = jax.device_put(params, shardings)
+        if opt_state is not None:
+            opt_state = jax.device_put(opt_state, shardings)
     else:
         shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s), param_specs,
             is_leaf=lambda x: isinstance(x, P))
         params = jax.tree.map(jax.device_put, params, shardings)
-    opt_state = jax.jit(optimizer.init)(params)
+        if opt_state is not None:
+            # moment buffers mirror the param tree; reuse its shardings where
+            # shapes line up, replicate the scalar counters
+            flat_shard = jax.tree.leaves(shardings)
+            shapes = {s.shape: sh for s, sh in
+                      zip(jax.tree.leaves(params), flat_shard)}
+            opt_state = jax.tree.map(
+                lambda x: jax.device_put(
+                    x, shapes.get(getattr(x, "shape", None),
+                                  NamedSharding(mesh, P()))), opt_state)
+    if opt_state is None:
+        opt_state = jax.jit(optimizer.init)(params)
     return params, opt_state
 
 
@@ -77,7 +93,10 @@ def _dalle_rule(tp: Optional[str], fsdp: Optional[str]):
     """
     def rule(path, leaf):
         keys = [getattr(k, "key", None) for k in path]
-        if "transformer" in keys:
+        # layer-stack params are recognized by their attn/ff sub-keys, so a
+        # BARE transformer tree (no 'transformer' ancestor) shards the same
+        # as one nested inside DALLE/CLIP params
+        if "attn" in keys or "ff" in keys:
             sub, name = keys[-2], keys[-1]
             if name == "w":
                 if sub in ("qkv", "w1"):

@@ -1,0 +1,9 @@
+"""Aux subsystems: metrics, profiling, debug toggles (SURVEY.md §5.1/2/5)."""
+
+from dalle_pytorch_tpu.utils.debug import (check_finite_tree,
+                                           enable_nan_checks, guard_loss)
+from dalle_pytorch_tpu.utils.metrics import MetricsLogger
+from dalle_pytorch_tpu.utils.profiling import StepProfiler, trace
+
+__all__ = ["MetricsLogger", "StepProfiler", "trace", "enable_nan_checks",
+           "check_finite_tree", "guard_loss"]

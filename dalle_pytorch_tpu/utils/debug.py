@@ -1,0 +1,47 @@
+"""Debug toggles: NaN checking and loss-sanity guards.
+
+SURVEY.md §5.2 — the reference has no sanitizers; JAX's own are one config
+flag away. ``enable_nan_checks`` flips jax_debug_nans/infs (every jit op
+re-checked — slow, debugging only). ``check_finite_tree``/``guard_loss`` are
+the cheap always-on variants the CLIs use to fail fast with context instead
+of training on garbage.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def enable_nan_checks(enable: bool = True) -> None:
+    """Global jax NaN/Inf trap — raises at the op that produced the first
+    non-finite value (disables some fusions; use for debugging runs)."""
+    jax.config.update("jax_debug_nans", enable)
+    jax.config.update("jax_debug_infs", enable)
+
+
+def check_finite_tree(tree: Any, name: str = "tree") -> None:
+    """Host-side assert that every leaf is finite (blocks on the values)."""
+    bad = []
+
+    def visit(path, leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            if not bool(jnp.isfinite(leaf).all()):
+                bad.append(jax.tree_util.keystr(path))
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    if bad:
+        raise FloatingPointError(
+            f"non-finite values in {name}: {', '.join(bad[:8])}"
+            + (" ..." if len(bad) > 8 else ""))
+
+
+def guard_loss(loss, step: int):
+    """Raise with step context when the scalar loss goes non-finite."""
+    val = float(loss)
+    if not jnp.isfinite(val):
+        raise FloatingPointError(f"loss became {val} at step {step}")
+    return val

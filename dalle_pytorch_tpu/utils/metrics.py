@@ -1,0 +1,72 @@
+"""Training metrics: throughput counters + JSONL logging.
+
+The reference's observability is bare ``print`` of per-interval batch loss
+and per-epoch averages (reference trainVAE.py:98-102,116-117,
+trainDALLE.py:201-210). SURVEY.md §5.5 asks the rebuild for real counters —
+tokens/sec/chip is the north-star metric, so the training CLIs log it per
+interval, not just in bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import jax
+
+
+class MetricsLogger:
+    """Per-step metrics with wall-clock throughput, echoed to stdout and
+    appended as JSONL (one object per log call) for post-hoc analysis."""
+
+    def __init__(self, path: Optional[str] = None, log_interval: int = 10,
+                 n_devices: Optional[int] = None):
+        """``n_devices`` is the number of chips actually participating in
+        the training mesh (NOT all local devices — a --dp subset must not
+        deflate the per-chip rate). Defaults to jax.device_count()."""
+        self.path = path
+        self.log_interval = log_interval
+        self.n_devices = n_devices
+        self._t_last = time.perf_counter()
+        self._units_since = 0
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def step(self, step: int, loss: float, *, epoch: Optional[int] = None,
+             units: int = 0, unit_name: str = "tokens", **extra) -> None:
+        """Call once per train step; prints/writes every ``log_interval``.
+        ``units`` is the work done this step (tokens, images...)."""
+        self._units_since += units
+        if step % self.log_interval != 0:
+            return
+        now = time.perf_counter()
+        dt = max(now - self._t_last, 1e-9)
+        rate = self._units_since / dt
+        n_dev = max(self.n_devices or jax.device_count(), 1)
+        rec = {
+            "step": step, "loss": float(loss),
+            f"{unit_name}_per_sec": round(rate, 2),
+            f"{unit_name}_per_sec_per_chip": round(rate / n_dev, 2),
+            "time": time.time(),
+        }
+        if epoch is not None:
+            rec["epoch"] = epoch
+        rec.update(extra)
+        self._t_last = now
+        self._units_since = 0
+        head = f"epoch {epoch} " if epoch is not None else ""
+        print(f"{head}step {step}  loss {rec['loss']:.6f}  "
+              f"{rec[f'{unit_name}_per_sec_per_chip']:.1f} "
+              f"{unit_name}/s/chip", flush=True)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+    def event(self, **fields) -> None:
+        """Free-form record (epoch summaries, checkpoint writes...)."""
+        rec = {"time": time.time(), **fields}
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")

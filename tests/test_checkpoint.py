@@ -1,0 +1,129 @@
+"""Checkpoint subsystem tests: round-trips (params/opt state/config/meta),
+atomicity guarantees, the name-and-epoch template, and the VAE->DALLE
+cross-CLI contract (SURVEY.md §5.4, reference trainVAE.py:119 ->
+trainDALLE.py:64-67)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dalle_pytorch_tpu import checkpoint as ckpt
+from dalle_pytorch_tpu.models import dalle as D
+from dalle_pytorch_tpu.models import vae as V
+
+
+@pytest.fixture(scope="module")
+def vae_setup():
+    cfg = V.VAEConfig(image_size=16, num_tokens=24, codebook_dim=32,
+                      num_layers=2, hidden_dim=8)
+    params = V.vae_init(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def tree_equal(a, b):
+    return bool(jax.tree.all(jax.tree.map(
+        lambda x, y: bool((np.asarray(x) == np.asarray(y)).all()), a, b)))
+
+
+class TestRoundTrip:
+    def test_params_and_manifest(self, tmp_path, vae_setup):
+        cfg, params = vae_setup
+        path = ckpt.save(str(tmp_path / "c"), params, step=7, config=cfg,
+                         kind="vae", meta={"temperature": 0.8})
+        params2, manifest = ckpt.restore_params(path)
+        assert tree_equal(params, params2)
+        assert manifest["kind"] == "vae"
+        assert manifest["step"] == 7
+        assert manifest["meta"]["temperature"] == 0.8
+        cfg2 = ckpt.vae_config_from_manifest(manifest)
+        assert cfg2 == cfg
+
+    def test_opt_state_roundtrip(self, tmp_path, vae_setup):
+        cfg, params = vae_setup
+        opt = optax.adam(1e-3)
+        state = opt.init(params)
+        # one real update so moments are non-trivial
+        grads = jax.tree.map(jnp.ones_like, params)
+        _, state = opt.update(grads, state, params)
+        path = ckpt.save(str(tmp_path / "c"), params, opt_state=state,
+                         config=cfg)
+        _, state2, _ = ckpt.restore(path, opt_target=opt.init(params))
+        assert tree_equal(state, state2)
+
+    def test_missing_opt_state_raises(self, tmp_path, vae_setup):
+        cfg, params = vae_setup
+        opt = optax.adam(1e-3)
+        path = ckpt.save(str(tmp_path / "c"), params)
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(path, opt_target=opt.init(params))
+
+    def test_bfloat16_leaves_survive(self, tmp_path):
+        tree = {"w": jnp.ones((4, 4), jnp.bfloat16) * 1.5}
+        path = ckpt.save(str(tmp_path / "c"), tree)
+        back, _ = ckpt.restore_params(path)
+        assert back["w"].dtype == jnp.bfloat16
+        assert tree_equal(tree, back)
+
+    def test_overwrite_existing(self, tmp_path, vae_setup):
+        cfg, params = vae_setup
+        p = str(tmp_path / "c")
+        ckpt.save(p, params, step=1)
+        ckpt.save(p, params, step=2)
+        assert ckpt.load_manifest(p)["step"] == 2
+
+    def test_dalle_config_roundtrip(self, tmp_path, vae_setup):
+        vcfg, _ = vae_setup
+        cfg = D.DALLEConfig(dim=32, depth=2, vae=vcfg, num_text_tokens=50,
+                            text_seq_len=8, heads=2, dim_head=16,
+                            sparse_attn=(True, False))
+        params = {"x": np.zeros((2,))}
+        path = ckpt.save(str(tmp_path / "c"), params, config=cfg,
+                         kind="dalle")
+        manifest = ckpt.load_manifest(path)
+        cfg2 = ckpt.dalle_config_from_manifest(manifest)
+        assert cfg2 == cfg
+
+
+class TestNaming:
+    def test_ckpt_path_template(self):
+        assert ckpt.ckpt_path("./models", "vae", 12).endswith("vae-12")
+
+    def test_latest(self, tmp_path, vae_setup):
+        cfg, params = vae_setup
+        for e in (0, 3, 11):
+            ckpt.save(ckpt.ckpt_path(str(tmp_path), "vae", e), params,
+                      step=e)
+        ckpt.save(ckpt.ckpt_path(str(tmp_path), "other", 99), params)
+        path, epoch = ckpt.latest(str(tmp_path), "vae")
+        assert epoch == 11 and path.endswith("vae-11")
+        assert ckpt.latest(str(tmp_path), "missing") is None
+
+    def test_no_tmp_dirs_left_behind(self, tmp_path, vae_setup):
+        cfg, params = vae_setup
+        ckpt.save(str(tmp_path / "c"), params)
+        leftovers = [d for d in os.listdir(tmp_path)
+                     if d.startswith(".ckpt-tmp-")]
+        assert leftovers == []
+
+
+class TestCrossCLIContract:
+    def test_vae_to_dalle_codebook_tie(self, tmp_path, vae_setup):
+        """train_vae writes; train_dalle restores and ties image_emb to the
+        codebook (reference trainVAE.py:119 -> trainDALLE.py:64-67 +
+        dalle_pytorch.py:283)."""
+        cfg, params = vae_setup
+        path = ckpt.save(ckpt.ckpt_path(str(tmp_path), "vae", 0), params,
+                         config=cfg, kind="vae")
+        vae_params, manifest = ckpt.restore_params(path)
+        vae_cfg = ckpt.vae_config_from_manifest(manifest)
+        dcfg = D.DALLEConfig(dim=vae_cfg.codebook_dim, depth=2, vae=vae_cfg,
+                             num_text_tokens=50, text_seq_len=8, heads=2,
+                             dim_head=16)
+        dalle_params = D.dalle_init(jax.random.PRNGKey(1), dcfg,
+                                    vae_params=vae_params)
+        assert tree_equal(dalle_params["image_emb"]["w"],
+                          vae_params["codebook"]["w"])

@@ -1,0 +1,183 @@
+"""End-to-end CLI tests on tiny synthetic data (SURVEY.md §4f): one real
+train_vae run (loss decreases, checkpoint restorable), kill/resume, the
+VAE->DALLE->gen_dalle pipeline text-in -> PNG-out, and the mix_vae demo."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu import checkpoint as ckpt
+
+IMG = 16          # tiny images: 2 conv layers -> 4x4 = 16 image tokens
+
+
+@pytest.fixture(scope="module")
+def workdir(tmp_path_factory):
+    """Synthetic dataset: 8 images + captions, shared dirs for all tests."""
+    from PIL import Image
+    root = tmp_path_factory.mktemp("cli")
+    img_dir = root / "imagedata" / "0"
+    img_dir.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    names = []
+    for i in range(8):
+        arr = np.zeros((IMG, IMG, 3), np.uint8)
+        # structured content so the VAE has something to learn
+        arr[:, :, i % 3] = 255
+        arr[i:i + 6, i:i + 6] = rng.integers(0, 255, (6, 6, 3))
+        name = f"img{i}.png"
+        Image.fromarray(arr).save(img_dir / name)
+        names.append(name)
+    colors = ["red", "blue", "green", "gray"]
+    (root / "only.txt").write_text(
+        "".join(f"a {colors[i % 4]} square\n" for i in range(8)))
+    (root / "pairs.txt").write_text(
+        "".join(f"{n} : a {colors[i % 4]} square\n"
+                for i, n in enumerate(names)))
+    (root / "models").mkdir()
+    (root / "results").mkdir()
+    return root
+
+
+def vae_args(root, extra=()):
+    return [
+        "--dataPath", str(root / "imagedata"),
+        "--imageSize", str(IMG), "--batchSize", "4",
+        "--num_layers", "2", "--num_tokens", "24", "--codebook_dim", "16",
+        "--hidden_dim", "8", "--lr", "3e-3",
+        "--models_dir", str(root / "models"),
+        "--results_dir", str(root / "results"),
+        "--metrics", str(root / "metrics.jsonl"),
+        "--log_interval", "1", "--dp", "1",
+    ] + list(extra)
+
+
+@pytest.mark.slow
+class TestTrainVAE:
+    def test_two_epochs_decreasing_loss_and_artifacts(self, workdir):
+        from dalle_pytorch_tpu.cli.train_vae import main
+        main(vae_args(workdir, ["--n_epochs", "2", "--tempsched"]))
+
+        # loss decreased epoch 0 -> 1
+        losses = {}
+        with open(workdir / "metrics.jsonl") as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("event") == "checkpoint":
+                    losses[rec["epoch"]] = rec["avg_loss"]
+        assert losses[1] < losses[0]
+
+        # recon grid written per epoch
+        assert (workdir / "results" / "vae_epoch_0.png").exists()
+        assert (workdir / "results" / "vae_epoch_1.png").exists()
+
+        # checkpoint restorable with config + schedule state
+        path, epoch = ckpt.latest(str(workdir / "models"), "vae")
+        assert epoch == 1
+        params, manifest = ckpt.restore_params(path)
+        assert manifest["kind"] == "vae"
+        assert manifest["meta"]["temperature"] < 0.9   # tempsched ran
+        cfg = ckpt.vae_config_from_manifest(manifest)
+        assert cfg.image_size == IMG and cfg.num_tokens == 24
+
+    def test_resume_from_checkpoint(self, workdir):
+        """Kill/resume: epoch numbering continues, opt state restores
+        (reference --loadVAE/--start_epoch, trainVAE.py:20-21,52-54)."""
+        from dalle_pytorch_tpu.cli.train_vae import main
+        main(vae_args(workdir, ["--n_epochs", "1", "--loadVAE", "vae",
+                                "--start_epoch", "2"]))
+        path, epoch = ckpt.latest(str(workdir / "models"), "vae")
+        assert epoch == 2
+        assert ckpt.load_manifest(path)["meta"]["epoch"] == 2
+
+
+@pytest.mark.slow
+class TestTrainDALLE:
+    def test_train_and_sample(self, workdir):
+        from dalle_pytorch_tpu.cli.train_dalle import main
+        main([
+            "--dataPath", str(workdir / "imagedata"),
+            "--imageSize", str(IMG), "--batchSize", "4",
+            "--captions_only", str(workdir / "only.txt"),
+            "--captions", str(workdir / "pairs.txt"),
+            "--vaename", "vae", "--vae_epoch", "2",
+            "--name", "toy", "--n_epochs", "1",
+            "--dim", "16", "--depth", "2", "--heads", "2",
+            "--dim_head", "8", "--num_text_tokens", "50",
+            "--text_seq_len", "8", "--attn_dropout", "0",
+            "--ff_dropout", "0", "--lr", "1e-3",
+            "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+            "--log_interval", "1", "--dp", "1", "--sample_every", "1",
+        ])
+        # checkpoint + vocab + sample grid exist
+        path, epoch = ckpt.latest(str(workdir / "models"), "toy_dalle")
+        assert epoch == 0
+        manifest = ckpt.load_manifest(path)
+        assert manifest["kind"] == "dalle"
+        assert manifest["meta"]["vae_checkpoint"].endswith("vae-2")
+        assert (workdir / "models" / "toy-vocab.json").exists()
+        assert (workdir / "results" / "toy_dalle_epoch_0.png").exists()
+
+        # codebook tie: image_emb was seeded from the VAE codebook and
+        # trained; config round-trips
+        cfg = ckpt.dalle_config_from_manifest(manifest)
+        assert cfg.dim == 16 and cfg.vae.num_tokens == 24
+
+    def test_gen_dalle_text_to_png(self, workdir):
+        from dalle_pytorch_tpu.cli.gen_dalle import main
+        main([
+            "a red square",
+            "--name", "toy", "--dalle_epoch", "0",
+            "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+            "--num_images", "2",
+        ])
+        outs = [f for f in os.listdir(workdir / "results")
+                if f.startswith("gendalletoy_epoch_0-")]
+        assert outs, "gen_dalle wrote no PNG"
+
+    def test_gen_dalle_oov_raises(self, workdir):
+        from dalle_pytorch_tpu.cli.gen_dalle import main
+        with pytest.raises(KeyError):
+            main(["a purple hexagon", "--name", "toy", "--dalle_epoch", "0",
+                  "--models_dir", str(workdir / "models"),
+                  "--results_dir", str(workdir / "results")])
+
+
+@pytest.mark.slow
+class TestMixVAE:
+    def test_mix_grids(self, workdir):
+        from dalle_pytorch_tpu.cli.mix_vae import main
+        out_dir = workdir / "mixed"
+        main([
+            "--vaename", "vae", "--load_epoch", "2",
+            "--models_dir", str(workdir / "models"),
+            "--dataPath", str(workdir / "imagedata"),
+            "--imageSize", str(IMG), "--batchSize", "4",
+            "--out_dir", str(out_dir), "--max_batches", "1",
+        ])
+        assert (out_dir / "mixed_epoch_2_0.png").exists()
+
+
+class TestResolveResume:
+    def test_bare_name_uses_latest(self, tmp_path):
+        from dalle_pytorch_tpu.cli.common import resolve_resume
+        params = {"w": np.zeros((2,))}
+        for e in (0, 4):
+            ckpt.save(ckpt.ckpt_path(str(tmp_path), "vae", e), params,
+                      step=e)
+        path, start = resolve_resume("vae", str(tmp_path), 0)
+        assert path.endswith("vae-4") and start == 5
+
+    def test_explicit_epoch(self, tmp_path):
+        from dalle_pytorch_tpu.cli.common import resolve_resume
+        path, start = resolve_resume("vae", str(tmp_path), 3)
+        assert path.endswith("vae-2") and start == 3
+
+    def test_missing_name_raises(self, tmp_path):
+        from dalle_pytorch_tpu.cli.common import resolve_resume
+        with pytest.raises(FileNotFoundError):
+            resolve_resume("ghost", str(tmp_path), 0)

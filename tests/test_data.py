@@ -1,0 +1,223 @@
+"""Data-layer tests: the Vocabulary contract, caption parsing/batching,
+image IO round-trips, and the prefetcher (SURVEY.md §5 data contract)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.data import (CaptionDataset, ImageFolderDataset,
+                                    PAD_TOKEN, Prefetcher, Vocabulary,
+                                    load_caption_data, load_image,
+                                    load_image_batch, prefetch,
+                                    read_caption_pairs, read_captions_only,
+                                    save_image_grid, shard_for_host,
+                                    text_mask, to_uint8)
+
+
+# ---------------------------------------------------------------------------
+# Vocabulary — reference Vocabulary.py:3-43 contract
+# ---------------------------------------------------------------------------
+
+class TestVocabulary:
+    def test_reserved_ids(self):
+        v = Vocabulary()
+        assert v.to_word(0) == "PAD"
+        assert v.to_word(1) == "SOS"
+        assert v.to_word(2) == "EOS"
+        assert v.num_words == 3
+
+    def test_words_number_from_three_in_first_seen_order(self):
+        v = Vocabulary()
+        v.add_sentence("a dog runs")
+        v.add_sentence("a cat runs fast")
+        assert v.to_index("a") == 3
+        assert v.to_index("dog") == 4
+        assert v.to_index("runs") == 5
+        assert v.to_index("cat") == 6
+        assert v.to_index("fast") == 7
+        assert v.word2count["a"] == 2
+        assert v.word2count["dog"] == 1
+
+    def test_oov_raises_keyerror(self):
+        # the reference's hard failure mode (Vocabulary.py:43)
+        v = Vocabulary()
+        v.add_sentence("hello world")
+        with pytest.raises(KeyError):
+            v.to_index("unseen")
+
+    def test_sentence_stats(self):
+        v = Vocabulary()
+        v.add_sentence("one two three")
+        v.add_sentence("one")
+        assert v.num_sentences == 2
+        assert v.longest_sentence == 3
+
+    def test_encode_pads_and_skips_empty(self):
+        v = Vocabulary()
+        v.add_sentence("a dog")
+        ids = v.encode("a  dog", pad_to=6)   # double space -> '' skipped
+        assert ids == [3, 4, 0, 0, 0, 0]
+        assert v.decode(ids) == "a dog"
+
+    def test_encode_overflow_raises(self):
+        v = Vocabulary()
+        v.add_sentence("a b c")
+        with pytest.raises(ValueError):
+            v.encode("a b c", pad_to=2)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        v = Vocabulary("caps")
+        v.add_sentence("the quick brown fox")
+        v.add_sentence("the lazy dog")
+        p = str(tmp_path / "vocab.json")
+        v.save(p)
+        w = Vocabulary.load(p)
+        assert w.word2index == v.word2index
+        assert w.index2word == v.index2word
+        assert w.num_words == v.num_words
+        assert w.longest_sentence == v.longest_sentence
+
+
+# ---------------------------------------------------------------------------
+# caption files — reference trainDALLE.py:92-163
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def caption_files(tmp_path):
+    (tmp_path / "only.txt").write_text(
+        "a red square\na blue circle\na green square\n")
+    (tmp_path / "pairs.txt").write_text(
+        "img0.png : a red square\n"
+        "img1.png : a blue circle\n"
+        "img2.png : a green square\n")
+    return str(tmp_path / "only.txt"), str(tmp_path / "pairs.txt")
+
+
+class TestCaptions:
+    def test_load_caption_data(self, caption_files):
+        only, pairs = caption_files
+        vocab, data = load_caption_data(only, pairs, text_seq_len=8)
+        assert len(data) == 3
+        fn, ids = data[0]
+        assert fn == "img0.png"
+        assert len(ids) == 8
+        assert ids[:3] == [vocab.to_index("a"), vocab.to_index("red"),
+                           vocab.to_index("square")]
+        assert ids[3:] == [PAD_TOKEN] * 5
+
+    def test_pairs_split_on_first_colon(self, tmp_path):
+        p = tmp_path / "pairs.txt"
+        p.write_text("a.png : caption with : colon\n")
+        [(fn, txt)] = read_caption_pairs(str(p))
+        assert fn == "a.png"
+        assert "colon" in txt
+
+    def test_dataset_fixed_batch_shape(self, caption_files):
+        only, pairs = caption_files
+        vocab, data = load_caption_data(only, pairs, text_seq_len=8)
+        ds = CaptionDataset(data, batch_size=2)
+        batches = list(ds.epoch(0))
+        assert len(batches) == 2
+        for paths, toks in batches:
+            assert len(paths) == 2          # tail batch wraps, not ragged
+            assert toks.shape == (2, 8)
+            assert toks.dtype == np.int32
+
+    def test_dataset_shuffle_deterministic(self, caption_files):
+        only, pairs = caption_files
+        _, data = load_caption_data(only, pairs, text_seq_len=8)
+        ds = CaptionDataset(data, batch_size=3, shuffle=True, seed=7)
+        a = [p for p, _ in ds.epoch(0)][0]
+        b = [p for p, _ in ds.epoch(0)][0]
+        c = [p for p, _ in ds.epoch(1)][0]
+        assert a == b                       # same epoch -> same order
+        assert set(a) == set(c)
+
+    def test_text_mask(self):
+        toks = np.array([[3, 4, 0, 0]])
+        assert (text_mask(toks) == [[True, True, False, False]]).all()
+
+
+# ---------------------------------------------------------------------------
+# image IO
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def image_dir(tmp_path):
+    from PIL import Image
+    d = tmp_path / "imgs" / "0"
+    d.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        arr = rng.integers(0, 256, (16, 16, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(d / f"img{i}.png")
+    return tmp_path / "imgs"
+
+
+class TestImages:
+    def test_load_image_range_and_shape(self, image_dir):
+        img = load_image(str(image_dir / "0" / "img0.png"), image_size=8)
+        assert img.shape == (8, 8, 3)
+        assert img.dtype == np.float32
+        assert img.min() >= -1.0 and img.max() <= 1.0
+
+    def test_load_image_batch_resolves_subdir(self, image_dir):
+        batch = load_image_batch(["img0.png", "img1.png"],
+                                 data_path=str(image_dir), image_size=16)
+        assert batch.shape == (2, 16, 16, 3)
+
+    def test_folder_dataset(self, image_dir):
+        ds = ImageFolderDataset(str(image_dir), image_size=16, batch_size=2,
+                                drop_last=False)
+        batches = list(ds)
+        assert len(batches) == 2
+        assert all(b.shape == (2, 16, 16, 3) for b in batches)
+
+    def test_to_uint8_normalize(self):
+        x = np.linspace(-1, 1, 12, dtype=np.float32).reshape(1, 2, 2, 3)
+        u = to_uint8(x, normalize=True)
+        assert u.min() == 0 and u.max() == 255
+
+    def test_save_image_grid(self, tmp_path):
+        from PIL import Image
+        imgs = np.random.default_rng(0).uniform(-1, 1, (6, 8, 8, 3))
+        out = str(tmp_path / "grid.png")
+        save_image_grid(imgs, out, nrow=3, padding=1)
+        w, h = Image.open(out).size
+        assert w == 3 * 9 + 1 and h == 2 * 9 + 1
+
+
+# ---------------------------------------------------------------------------
+# prefetch + host sharding
+# ---------------------------------------------------------------------------
+
+class TestPrefetch:
+    def test_prefetch_preserves_order_and_values(self):
+        batches = [np.full((2, 3), i, np.float32) for i in range(5)]
+        out = list(prefetch(iter(batches), depth=2))
+        assert len(out) == 5
+        for i, b in enumerate(out):
+            assert np.asarray(b).flatten()[0] == i
+
+    def test_transform_runs_in_worker(self):
+        out = list(Prefetcher(iter([1, 2, 3]), depth=1,
+                              transform=lambda x: np.full((2,), x * 10)))
+        assert [int(np.asarray(o)[0]) for o in out] == [10, 20, 30]
+
+    def test_error_propagates(self):
+        def gen():
+            yield np.zeros((1,))
+            raise RuntimeError("boom")
+        it = prefetch(gen())
+        next(it)
+        with pytest.raises(RuntimeError, match="boom"):
+            next(it)
+            next(it)
+
+    def test_shard_for_host(self):
+        items = list(range(10))
+        assert shard_for_host(items, 0, 3) == [0, 1, 2]
+        assert shard_for_host(items, 2, 3) == [6, 7, 8]
+        with pytest.raises(ValueError):
+            shard_for_host([1], 0, 2)

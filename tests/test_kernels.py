@@ -152,3 +152,69 @@ def test_transformer_sparse_impl_pallas_matches_ref(key):
     yr = transformer_apply(params, x, cfg=cfg_r)
     yp = transformer_apply(params, x, cfg=cfg_p)
     np.testing.assert_allclose(np.array(yr), np.array(yp), atol=1e-4)
+
+
+def test_flash_gradients_ragged_seq(key):
+    """Backward at a sequence length NOT a multiple of the block (ADVICE r1:
+    the bwd asserted n % block_k == 0 while the forward padded — e.g. DALLE
+    text_seq_len=300 -> seq 1324). Grads must match dense exactly."""
+    n = 200                                      # 200 % 128 != 0
+    q, k, v = _qkv(key, n=n)
+    mask = jnp.ones((2, n), bool).at[:, 180:].set(False)
+    tgt = jax.random.normal(key, q.shape)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, scale=0.2, causal=True, mask=mask,
+                            block_q=128, block_k=128)
+        return jnp.sum((o - tgt) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum((dense_oracle(q, k, v, 0.2, True, mask) - tgt) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.array(a), np.array(b), atol=5e-4)
+
+
+def test_block_sparse_gradients_ragged_seq(key):
+    """Same ragged-length regression for the block-sparse backward."""
+    n = 160                                      # multiple of block=16 only
+    q, k, v = _qkv(key, n=n)
+    tgt = jax.random.normal(key, q.shape)
+
+    def loss_pallas(q, k, v):
+        o = block_sparse_attention(q, k, v, scale=0.2, causal=True,
+                                   block=16, block_q=128, block_k=128)
+        return jnp.sum((o - tgt) ** 2)
+
+    def loss_ref(q, k, v):
+        o = sparse.sparse_attention_ref(q, k, v, scale=0.2, causal=True,
+                                        block=16)
+        return jnp.sum((o - tgt) ** 2)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.array(a), np.array(b), atol=5e-4)
+
+
+def test_flash_gradients_ragged_no_mask_non_causal(key):
+    """Ragged + no pad mask + non-causal: padded key columns must still be
+    excluded from dq (structural bound added by the bwd itself)."""
+    n = 72
+    q, k, v = _qkv(key, n=n)
+    tgt = jax.random.normal(key, q.shape)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, scale=0.3, causal=False,
+                            block_q=64, block_k=64)
+        return jnp.sum((o - tgt) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum((dense_oracle(q, k, v, 0.3, False, None) - tgt) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.array(a), np.array(b), atol=5e-4)

@@ -156,3 +156,20 @@ def test_replicate_helper(key):
     tree = {"a": jnp.ones((4, 4))}
     out = replicate(mesh, tree)
     assert out["a"].sharding.is_fully_replicated
+
+
+def test_bare_transformer_param_specs_shard(key):
+    """A bare transformer tree (no 'transformer' ancestor) gets real tp
+    specs — ADVICE r1: the rule used to silently replicate everything."""
+    from jax.sharding import PartitionSpec as P
+
+    from dalle_pytorch_tpu.ops.transformer import (TransformerConfig,
+                                                   transformer_init)
+    cfg = TransformerConfig(dim=32, depth=2, seq_len=16, heads=2,
+                            dim_head=16)
+    params = transformer_init(key, cfg)
+    specs = dalle_param_specs(params, tp="tp")
+    assert specs["attn"]["qkv"]["w"] == P(None, None, "tp")
+    assert specs["attn"]["out"]["w"] == P(None, "tp", None)
+    assert specs["ff"]["w1"]["w"] == P(None, None, "tp")
+    assert specs["ff"]["w2"]["w"] == P(None, "tp", None)
