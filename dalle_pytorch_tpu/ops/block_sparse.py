@@ -34,30 +34,36 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-from dalle_pytorch_tpu.ops.flash_attention import (FILL,
+from dalle_pytorch_tpu.ops.flash_attention import (FILL, NUM_LANES,
+                                                   NUM_SUBLANES,
                                                    blockwise_attention_bwd)
 
 Array = jax.Array
 
 
 def _structural(rows, cols, *, block, window, global_blocks, causal):
-    """Token-level layout mask at absolute positions (rows x cols)."""
-    same_window = (rows[:, None] // window) == (cols[None, :] // window)
+    """Layout mask at absolute positions; ``rows`` and ``cols`` are mutually
+    broadcastable (e.g. (BQ, 1) x (1, BK)) — kept 2-D so the Pallas kernel
+    never builds 1-D vectors Mosaic can't lower."""
+    same_window = (rows // window) == (cols // window)
     allow = same_window
     for g in global_blocks:
-        allow = allow | ((cols[None, :] // block) == g)
+        allow = allow | ((cols // block) == g)
     if causal:
-        allow = allow & (cols[None, :] <= rows[:, None])
+        allow = allow & (cols <= rows)
     return allow
 
 
-def _kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *, scale,
-            causal, block_q, block_k, seq_len, has_mask, block, window,
-            global_blocks):
+def _kernel(*refs, scale, causal, block_q, block_k, seq_len, has_mask, block,
+            window, global_blocks):
+    if has_mask:
+        mk_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref = refs
     iq = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale
     rows = iq * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)[:, 0]
+        jnp.int32, (block_q, 1), 0)                       # (BQ, 1)
 
     num_k = pl.cdiv(seq_len, block_k)
     if causal:
@@ -84,14 +90,14 @@ def _kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *, scale,
             s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             cols = ik * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)[0, :]
+                jnp.int32, (1, block_k), 1)               # (1, BK)
             if has_mask:
-                km = mask_ref[0, pl.ds(ik * block_k, block_k)]
-                s = jnp.where(km[None, :], s, FILL)   # keys only (reference)
+                km = mk_ref[0, :1, pl.ds(ik * block_k, block_k)] != 0
+                s = jnp.where(km, s, FILL)        # keys only (reference)
             struct = _structural(rows, cols, block=block, window=window,
                                  global_blocks=global_blocks, causal=causal)
             if seq_len % block_k:             # ragged tail tile bounds
-                struct = struct & (cols < seq_len)[None, :]
+                struct = struct & (cols < seq_len)
             s = jnp.where(struct, s, -jnp.inf)
 
             m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
@@ -113,9 +119,11 @@ def _kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *, scale,
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    # (m, l) saved separately — see ops.flash_attention on lse absorption
-    m_ref[0] = jnp.where(jnp.isfinite(m), m, 0.0)[:, 0]
-    l_ref[0] = l_safe[:, 0]
+    # (m, l) saved separately — see ops.flash_attention on lse absorption;
+    # lane-broadcast (BQ, 128) tiles to satisfy Mosaic tiling.
+    m_fin = jnp.where(jnp.isfinite(m), m, 0.0)
+    m_ref[0] = jnp.broadcast_to(m_fin, (block_q, NUM_LANES))
+    l_ref[0] = jnp.broadcast_to(l_safe, (block_q, NUM_LANES))
 
 
 def _bs_fwd(q, k, v, mask, scale, causal, block, num_local_blocks,
@@ -129,7 +137,6 @@ def _bs_fwd(q, k, v, mask, scale, causal, block, num_local_blocks,
     b, h, n, d = q.shape
     bh = b * h
     has_mask = mask is not None
-    mask_in = _pad_seq(mask, mult, 1) if has_mask else jnp.ones((b, 1), bool)
     window = num_local_blocks * block
 
     kernel = functools.partial(
@@ -137,30 +144,41 @@ def _bs_fwd(q, k, v, mask, scale, causal, block, num_local_blocks,
         block_k=block_k, seq_len=n_orig, has_mask=has_mask, block=block,
         window=window, global_blocks=global_blocks)
 
+    in_specs = []
+    inputs = []
+    if has_mask:
+        mask_in = _pad_seq(mask, mult, 1).astype(jnp.int32)
+        # key-only pad mask (reference contract), sublane-broadcast
+        mk = jnp.broadcast_to(mask_in[:, None, :], (b, NUM_SUBLANES, n))
+        in_specs.append(
+            pl.BlockSpec((1, NUM_SUBLANES, n), lambda ib, iq: (ib // h, 0, 0)))
+        inputs.append(mk)
+    in_specs += [
+        pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
+        pl.BlockSpec((1, n, d), lambda ib, iq: (ib, 0, 0)),
+        pl.BlockSpec((1, n, d), lambda ib, iq: (ib, 0, 0)),
+    ]
+    inputs += [q.reshape(bh, n, d), k.reshape(bh, n, d), v.reshape(bh, n, d)]
+
     out, m, l = pl.pallas_call(
         kernel,
         grid=(bh, pl.cdiv(n, block_q)),
-        in_specs=[
-            pl.BlockSpec((1, mask_in.shape[1]), lambda ib, iq: (ib // h, 0)),
-            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
-            pl.BlockSpec((1, n, d), lambda ib, iq: (ib, 0, 0)),
-            pl.BlockSpec((1, n, d), lambda ib, iq: (ib, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda ib, iq: (ib, iq)),
-            pl.BlockSpec((1, block_q), lambda ib, iq: (ib, iq)),
+            pl.BlockSpec((1, block_q, NUM_LANES), lambda ib, iq: (ib, iq, 0)),
+            pl.BlockSpec((1, block_q, NUM_LANES), lambda ib, iq: (ib, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, n, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, n), jnp.float32),
-            jax.ShapeDtypeStruct((bh, n), jnp.float32),
+            jax.ShapeDtypeStruct((bh, n, NUM_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, n, NUM_LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(mask_in, q.reshape(bh, n, d), k.reshape(bh, n, d), v.reshape(bh, n, d))
+    )(*inputs)
     out = out.reshape(b, h, n, d)[:, :, :n_orig]
-    m = m.reshape(b, h, n)[:, :, :n_orig]
-    l = l.reshape(b, h, n)[:, :, :n_orig]
+    m = m[:, :, 0].reshape(b, h, n)[:, :, :n_orig]
+    l = l[:, :, 0].reshape(b, h, n)[:, :, :n_orig]
     return out, (m, l)
 
 
@@ -186,8 +204,9 @@ def _bs_bwd_rule(scale, causal, block, num_local_blocks, global_blocks,
     window = num_local_blocks * block
 
     def structural(rows, cols):
-        return _structural(rows, cols, block=block, window=window,
-                           global_blocks=global_blocks, causal=causal)
+        return _structural(rows[:, None], cols[None, :], block=block,
+                           window=window, global_blocks=global_blocks,
+                           causal=causal)
 
     dq, dk, dv = blockwise_attention_bwd(
         q, k, v, mask, dout, out, stats, scale=scale,

@@ -48,15 +48,28 @@ FILL = -3.0e38           # finite pad fill (torch masked_fill -fmax behavior)
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
-                scale: float, causal: bool, block_q: int, block_k: int,
-                seq_len: int, has_mask: bool):
+# Mosaic tiling constants: the last two dims of every block must be
+# (multiples of) the (8, 128) f32 VREG tile or equal the array dims — the
+# layouts below mirror jax.experimental.pallas.ops.tpu.flash_attention
+# (q-mask broadcast over NUM_LANES, k-mask over NUM_SUBLANES, (m, l) stats
+# stored as (block_q, 128) lane-broadcast tiles).
+NUM_LANES = 128
+NUM_SUBLANES = 8
+
+
+def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
+                block_k: int, seq_len: int, has_mask: bool):
+    if has_mask:
+        mq_ref, mk_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref = refs
     iq = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale              # (BQ, d)
     rows = iq * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     cols_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    qm = (mask_ref[0, pl.ds(iq * block_q, block_q)] if has_mask else None)
+    # (BQ, 1) bool: query-row pad mask (any lane of the broadcast tile)
+    qm = (mq_ref[0][:, :1] != 0) if has_mask else None
 
     num_k = pl.cdiv(seq_len, block_k)
     if causal:
@@ -69,8 +82,8 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if has_mask:
-            km = mask_ref[0, pl.ds(ik * block_k, block_k)]
-            pad_ok = km[None, :] & qm[:, None]
+            km = mk_ref[0, :1, pl.ds(ik * block_k, block_k)] != 0  # (1, BK)
+            pad_ok = km & qm
             s = jnp.where(pad_ok, s, FILL)
         cols = ik * block_k + cols_base
         if causal:
@@ -97,8 +110,9 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
     # m and l are saved SEPARATELY: a single lse = m + log(l) loses the
     # log(l) term entirely when m is the huge finite FILL (float absorption),
     # corrupting the backward's softmax reconstruction at degenerate rows.
-    m_ref[0] = m[:, 0]
-    l_ref[0] = l_safe[:, 0]
+    # Stored lane-broadcast as (BQ, 128) tiles to satisfy Mosaic tiling.
+    m_ref[0] = jnp.broadcast_to(m, (block_q, NUM_LANES))
+    l_ref[0] = jnp.broadcast_to(l_safe, (block_q, NUM_LANES))
 
 
 def _pad_seq(x, mult, axis):
@@ -123,36 +137,51 @@ def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k, interpret):
     b, h, n, d = q.shape
     bh = b * h
     has_mask = mask is not None
-    mask_in = _pad_seq(mask, mult, 1) if has_mask else jnp.ones((b, 1), bool)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, seq_len=n_orig, has_mask=has_mask)
 
+    in_specs = []
+    inputs = []
+    if has_mask:
+        mask_in = _pad_seq(mask, mult, 1).astype(jnp.int32)  # (b, n)
+        # q-side: broadcast over lanes; k-side: broadcast over sublanes —
+        # gives the kernel 2-D (BQ, 1) / (1, BK) views with no transposes.
+        mq = jnp.broadcast_to(mask_in[:, :, None], (b, n, NUM_LANES))
+        mk = jnp.broadcast_to(mask_in[:, None, :], (b, NUM_SUBLANES, n))
+        in_specs += [
+            pl.BlockSpec((1, block_q, NUM_LANES),
+                         lambda ib, iq: (ib // h, iq, 0)),
+            pl.BlockSpec((1, NUM_SUBLANES, n), lambda ib, iq: (ib // h, 0, 0)),
+        ]
+        inputs += [mq, mk]
+    in_specs += [
+        pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
+        pl.BlockSpec((1, n, d), lambda ib, iq: (ib, 0, 0)),
+        pl.BlockSpec((1, n, d), lambda ib, iq: (ib, 0, 0)),
+    ]
+    inputs += [q.reshape(bh, n, d), k.reshape(bh, n, d), v.reshape(bh, n, d)]
+
     out, m, l = pl.pallas_call(
         kernel,
         grid=(bh, pl.cdiv(n, block_q)),
-        in_specs=[
-            pl.BlockSpec((1, mask_in.shape[1]), lambda ib, iq: (ib // h, 0)),
-            pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
-            pl.BlockSpec((1, n, d), lambda ib, iq: (ib, 0, 0)),
-            pl.BlockSpec((1, n, d), lambda ib, iq: (ib, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda ib, iq: (ib, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda ib, iq: (ib, iq)),
-            pl.BlockSpec((1, block_q), lambda ib, iq: (ib, iq)),
+            pl.BlockSpec((1, block_q, NUM_LANES), lambda ib, iq: (ib, iq, 0)),
+            pl.BlockSpec((1, block_q, NUM_LANES), lambda ib, iq: (ib, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, n, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, n), jnp.float32),
-            jax.ShapeDtypeStruct((bh, n), jnp.float32),
+            jax.ShapeDtypeStruct((bh, n, NUM_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, n, NUM_LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(mask_in, q.reshape(bh, n, d), k.reshape(bh, n, d), v.reshape(bh, n, d))
+    )(*inputs)
     out = out.reshape(b, h, n, d)[:, :, :n_orig]
-    m = m.reshape(b, h, n)[:, :, :n_orig]
-    l = l.reshape(b, h, n)[:, :, :n_orig]
+    m = m[:, :, 0].reshape(b, h, n)[:, :, :n_orig]
+    l = l[:, :, 0].reshape(b, h, n)[:, :, :n_orig]
     return out, (m, l)
 
 
