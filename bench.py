@@ -1,20 +1,30 @@
-"""Benchmark harness — prints ONE JSON line with the north-star metric.
+"""Benchmark harness — prints ONE JSON line for the driver.
 
-Default run measures the BASELINE.json north star on the depth-12 dim-512
-DALLE over the full 1280-token text+image sequence, bfloat16, jit train step
-with adam over a ``dp`` mesh of every local device:
+Default run (``--config all``) measures every BASELINE.json config and emits
+a single combined JSON object: the top-level fields are the north-star
+metric (config 2/5 scaled down to the local chip count), and ``configs``
+holds the DiscreteVAE (1), reversible+rerank (3), depth-64 block-sparse (4)
+numbers plus an on-device Pallas-kernel parity smoke:
 
   * ``value`` — steady-state train tokens/sec/chip (tokens / sec / devices
     actually participating in the sharded step);
   * ``mfu`` — measured model FLOP utilization against the chip's bf16 peak
-    (analytic fwd+bwd matmul+attention FLOP count, not an estimate);
-  * ``gen_p50_ms`` — generate_images p50 latency (jit lax.scan KV-cache
-    sampler, full 256-token prompt -> 1024 image tokens), the other half of
-    the BASELINE metric;
+    (analytic fwd+bwd matmul+attention FLOP count, not an estimate). The
+    harness REFUSES to emit an MFU outside (0, 1) — that would mean the
+    timing sync is broken, not that the chip is fast;
+  * ``gen_p50_ms`` / ``gen_ms_per_token`` — p50 latency of the jit-compiled
+    KV-cache sampler (full 256-token prompt -> 1024 image tokens);
   * ``vs_baseline`` — value / 2.9e5, an estimated A100 throughput for the
     same model (~430 MFLOPs/token at 40% MFU of 312 bf16 TFLOPs; the
     reference publishes no numbers, BASELINE.md). The >=1.5 target is the
     north star's ">= 1.5x A100 tokens/sec/chip".
+
+Timing discipline (VERDICT r2): on the axon platform ``block_until_ready``
+returns without waiting for remote execution, so every timed region here
+ends with a HOST FETCH of a value data-dependent on the full computation
+(``float(loss)`` after the last step; an element of the generated image).
+``scripts/axon_sync_repro.py`` is the committed repro of the platform
+behavior that forced this.
 
 Attention path: ``--attn xla|flash`` (default flash on TPU — the Pallas
 kernel; auto-falls back to xla with a note if the kernel fails to compile).
@@ -25,12 +35,7 @@ process, so on backend-init failure bench RE-EXECS itself (fresh claim), up
 to --retries times with backoff; if all attempts fail it prints a
 DIAGNOSTIC JSON line (never a bare stack trace) and exits 1.
 
-Other configs (BASELINE "configs"): --config vae (1: DiscreteVAE 256px
-recon step), --config rev (3: depth-12 reversible + CLIP-reranked
-generate), --config sparse (4: depth-64 sparse_attn=(True,False)*32,
-Pallas block-sparse vs ref), each printing its own JSON line.
-
-Usage: python bench.py [--tiny] [--config north|vae|rev|sparse]
+Usage: python bench.py [--tiny] [--config all|north|vae|rev|sparse|kernels]
                        [--attn xla|flash] [--steps N] [--batch B]
 """
 
@@ -61,6 +66,15 @@ def _bf16_peak():
         if gen.startswith(k):
             return v
     return BF16_PEAK["v5e"]
+
+
+def _fetch(x) -> float:
+    """Host round-trip on one element of ``x`` — the only reliable sync on
+    this platform (block_until_ready returns early; see module docstring
+    and scripts/axon_sync_repro.py). The element is data-dependent on the
+    whole program that produced ``x``, so fetching it forces completion."""
+    import numpy as np
+    return float(np.asarray(x.reshape(-1)[:1])[0])
 
 
 # ---------------------------------------------------------------------------
@@ -134,17 +148,22 @@ def setup_train(cfg, batch, mesh):
 
 
 def time_steps(step, params, opt_state, data, key, warmup, steps):
+    """Wall time for ``steps`` chained train steps, host-synced.
+
+    The timed region dispatches every step and then FETCHES the last loss:
+    each loss depends on the previous step's params, so the fetch cannot
+    complete before all ``steps`` executions have."""
     import jax
     for i in range(max(warmup, 1)):
         params, opt_state, loss = step(params, opt_state, data,
                                        jax.random.fold_in(key, i))
-    jax.block_until_ready(loss)
+    _fetch(loss)                              # drain warmup before timing
     t0 = time.perf_counter()
     for i in range(steps):
         params, opt_state, loss = step(params, opt_state, data,
                                        jax.random.fold_in(key, 100 + i))
-    jax.block_until_ready(loss)
-    return time.perf_counter() - t0, float(loss), params
+    loss_val = _fetch(loss)                   # host sync INSIDE the region
+    return time.perf_counter() - t0, loss_val, params
 
 
 # ---------------------------------------------------------------------------
@@ -186,10 +205,15 @@ def bench_north(args):
     flops_tok = dalle_train_flops_per_token(cfg)
     mfu = (tps_chip * flops_tok) / _bf16_peak() \
         if jax.default_backend() == "tpu" else None
+    if mfu is not None and not 0.0 < mfu < 1.0:
+        raise RuntimeError(
+            f"implausible measurement: mfu={mfu:.4f} "
+            f"({tps_chip:.0f} tokens/sec/chip) — timing sync broken, "
+            "refusing to emit (VERDICT r2 guard)")
 
-    gen_p50 = None
+    gen_p50 = gen_ms_tok = None
     if not args.no_gen:
-        gen_p50 = bench_generate(cfg, params, args)
+        gen_p50, gen_ms_tok = bench_generate(cfg, params, args)
 
     out = {
         "metric": ("DALLE train tokens/sec/chip (depth-12 dim-512, seq "
@@ -203,15 +227,20 @@ def bench_north(args):
         "loss": round(loss, 4),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "gen_p50_ms": gen_p50,
+        "gen_ms_per_token": gen_ms_tok,
         "backend": jax.default_backend(),
     }
     if note:
         out["note"] = note
-    _emit(out)
+    return out
 
 
 def bench_generate(cfg, params, args, clip_bundle=None, reps=None):
-    """p50 wall latency of the jit KV-cache sampler, full-length prompt."""
+    """(p50 ms, ms/token) of the jit-compiled KV-cache sampler, full-length
+    prompt. The whole sampler (prefill + lax.scan decode + VAE decode) is
+    ONE jit program — not the eager dispatch VERDICT r2 item 4 flagged."""
+    import functools
+
     import jax
     import jax.numpy as jnp
 
@@ -222,22 +251,41 @@ def bench_generate(cfg, params, args, clip_bundle=None, reps=None):
     vae_params = V.vae_init(key, cfg.vae, dtype=jnp.bfloat16)
     text = jax.random.randint(key, (1, cfg.text_seq_len), 0,
                               cfg.num_text_tokens)
-    kwargs = {}
+    n_gen = cfg.seq_len - cfg.text_seq_len    # image tokens generated
+
     if clip_bundle is not None:
-        kwargs = {"clip_params": clip_bundle[0], "clip_cfg": clip_bundle[1]}
+        clip_params, clip_cfg = clip_bundle
 
-    def run(i):
-        out = D.generate_images(params, vae_params, text, cfg=cfg,
-                                rng=jax.random.fold_in(key, i), **kwargs)
-        jax.block_until_ready(out)
+        @jax.jit
+        def gen(params, vae_params, clip_params, text, rng):
+            return D.generate_images(params, vae_params, text, cfg=cfg,
+                                     rng=rng, clip_params=clip_params,
+                                     clip_cfg=clip_cfg)
 
-    run(0)                                    # compile
+        run = functools.partial(gen, params, vae_params, clip_params, text)
+
+        def sync(out):
+            # fetch the SCORES: they depend on both the sampler and the
+            # CLIP forward, so the rerank compute stays inside the timing
+            return _fetch(out[1])             # (images, scores)
+    else:
+
+        @jax.jit
+        def gen(params, vae_params, text, rng):
+            return D.generate_images(params, vae_params, text, cfg=cfg,
+                                     rng=rng)
+
+        run = functools.partial(gen, params, vae_params, text)
+        sync = _fetch
+
+    sync(run(jax.random.fold_in(key, 0)))     # compile + first run
     times = []
     for i in range(reps or args.gen_reps):
         t0 = time.perf_counter()
-        run(1 + i)
+        sync(run(jax.random.fold_in(key, 1 + i)))
         times.append((time.perf_counter() - t0) * 1e3)
-    return round(statistics.median(times), 1)
+    p50 = statistics.median(times)
+    return round(p50, 1), round(p50 / n_gen, 3)
 
 
 def bench_vae(args):
@@ -272,13 +320,13 @@ def bench_vae(args):
     dt, loss, _ = time_steps(step, params, opt_state, data, key,
                              args.warmup, args.steps)
     ips = args.steps * batch / dt / n_dev
-    _emit({
+    return {
         "metric": "DiscreteVAE train images/sec/chip (256px, 3-layer, 2048 "
                   "tokens)" if not args.tiny else "tiny vae images/sec/chip",
         "value": round(ips, 2), "unit": "images/sec/chip",
         "vs_baseline": None, "loss": round(loss, 4), "batch": batch,
         "devices": n_dev, "backend": jax.default_backend(),
-    })
+    }
 
 
 def bench_rev(args):
@@ -315,21 +363,24 @@ def bench_rev(args):
                             visual_image_size=cfg.vae.image_size)
     clip_params = C.clip_init(jax.random.PRNGKey(7), ccfg,
                               dtype=jnp.bfloat16)
-    gen_p50 = bench_generate(cfg, params, args,
-                             clip_bundle=(clip_params, ccfg))
-    _emit({
+    gen_p50, gen_ms_tok = bench_generate(cfg, params, args,
+                                         clip_bundle=(clip_params, ccfg))
+    return {
         "metric": "DALLE reversible train tokens/sec/chip (depth-12) + CLIP "
                   "rerank gen" if not args.tiny else "tiny reversible",
         "value": round(tps_chip, 1), "unit": "tokens/sec/chip",
         "vs_baseline": round(tps_chip / A100_TOKENS_PER_SEC_EST, 3),
-        "gen_rerank_p50_ms": gen_p50, "loss": round(loss, 4),
+        "gen_rerank_p50_ms": gen_p50, "gen_rerank_ms_per_token": gen_ms_tok,
+        "loss": round(loss, 4),
         "devices": n_dev, "backend": jax.default_backend(),
-    })
+    }
 
 
 def bench_sparse(args):
     """BASELINE config 4: depth-64 sparse_attn=(True,False)*32 via the
     Pallas block-sparse kernel, vs the ref (einsum) sparse path."""
+    import dataclasses
+
     import jax
 
     from dalle_pytorch_tpu.parallel import make_mesh
@@ -337,17 +388,20 @@ def bench_sparse(args):
     n_dev = len(jax.devices())
     mesh = make_mesh({"dp": n_dev})
     depth = 64 if not args.tiny else 2
-    batch = args.batch or (2 * n_dev if not args.tiny else 4)
-    import dataclasses
+    # batch 1/chip: depth-64's per-layer activation stacks for bwd overflow
+    # a single chip's HBM at batch 2 (remat="full" instead sends the
+    # remat+cond+pallas nest into a pathological Mosaic/XLA compile)
+    batch = args.batch or (n_dev if not args.tiny else 4)
+    steps = max(1, args.steps // 2)           # depth-64 x2 impls: keep short
     results = {}
     for impl in ("pallas", "ref"):
         cfg = dataclasses.replace(build_cfg(args.tiny, depth=depth,
                                             sparse=True), sparse_impl=impl)
         step, params, opt_state, data, key = setup_train(cfg, batch, mesh)
         dt, loss, _ = time_steps(step, params, opt_state, data, key,
-                                 args.warmup, args.steps)
-        results[impl] = args.steps * batch * cfg.seq_len / dt / n_dev
-    _emit({
+                                 args.warmup, steps)
+        results[impl] = steps * batch * cfg.seq_len / dt / n_dev
+    return {
         "metric": "DALLE depth-64 block-sparse train tokens/sec/chip "
                   "(pallas kernel)" if not args.tiny else "tiny sparse",
         "value": round(results["pallas"], 1), "unit": "tokens/sec/chip",
@@ -356,19 +410,103 @@ def bench_sparse(args):
                                        3),
         "ref_tokens_sec_chip": round(results["ref"], 1),
         "devices": n_dev, "backend": jax.default_backend(),
-    })
+    }
+
+
+def bench_kernels(args):
+    """Compiled-mode kernel smoke (VERDICT r2 item 6): flash + block-sparse
+    forward AND backward, compiled on the current backend (Pallas Mosaic on
+    TPU — never the interpreter), parity-checked against the XLA einsum
+    paths. A Mosaic lowering regression fails this loudly instead of hiding
+    behind interpret-mode tests."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.ops.attention import dense_attention_weights
+    from dalle_pytorch_tpu.ops.block_sparse import block_sparse_attention
+    from dalle_pytorch_tpu.ops.flash_attention import flash_attention
+    from dalle_pytorch_tpu.ops.sparse import sparse_attention_ref
+
+    b, h, n, d = (1, 2, 64, 16) if args.tiny else (2, 4, 256, 64)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, h, n, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, n, d), jnp.float32)
+    v = jax.random.normal(kv, (b, h, n, d), jnp.float32)
+    # last batch row half-padded: exercises the pad-mask kernel path
+    lens = jnp.full((b, 1), n).at[-1, 0].set(n // 2)
+    mask = jnp.arange(n)[None, :] < lens
+    scale = d ** -0.5
+
+    def flash(q, k, v):
+        return flash_attention(q, k, v, scale=scale, causal=True, mask=mask)
+
+    def dense_ref(q, k, v):
+        w = dense_attention_weights(q, k, scale, mask, True)
+        return jnp.einsum("bhij,bhjd->bhid", w, v)
+
+    def bs(q, k, v):
+        return block_sparse_attention(q, k, v, scale=scale, causal=True,
+                                      mask=mask)
+
+    def bs_ref(q, k, v):
+        return sparse_attention_ref(q, k, v, scale=scale, causal=True,
+                                    mask=mask)
+
+    def sq_loss(f):
+        return lambda q, k, v: (f(q, k, v).astype(jnp.float32) ** 2).sum()
+
+    out = {}
+    # RELATIVE error: TPU MXU matmuls round f32 operands through bf16
+    # passes, so kernel-vs-XLA abs diffs sit at ~0.5% of magnitude by
+    # construction (measured 0.4-0.7% rel on-chip). 2% catches real lowering
+    # bugs (wrong mask, wrong tile, stale stats all blow past 100%).
+    for name, fn, ref in (("flash", flash, dense_ref),
+                          ("block_sparse", bs, bs_ref)):
+        o = jax.jit(fn)(q, k, v)
+        r = ref(q, k, v)
+        out[f"{name}_fwd_reldiff"] = float(
+            jnp.max(jnp.abs(o - r)) / jnp.max(jnp.abs(r)))
+        g = jax.jit(jax.grad(sq_loss(fn), argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.grad(sq_loss(ref), argnums=(0, 1, 2))(q, k, v)
+        out[f"{name}_grad_reldiff"] = float(
+            max(jnp.max(jnp.abs(a - b_)) / jnp.max(jnp.abs(b_))
+                for a, b_ in zip(g, gr)))
+    out["backend"] = jax.default_backend()
+    out["parity_ok"] = all(val < 2e-2 for key, val in out.items()
+                           if key.endswith("reldiff"))
+    if not out["parity_ok"]:
+        raise RuntimeError(f"kernel parity FAILED: {out}")
+    return out
 
 
 # ---------------------------------------------------------------------------
 # entry with backend-failure re-exec
 # ---------------------------------------------------------------------------
 
+def bench_all(args):
+    """Every BASELINE config in one combined JSON object. The north star is
+    the top level; each sub-config records its result (or its error — one
+    broken config must not hide the others' numbers)."""
+    out = bench_north(args)
+    out["configs"] = {}
+    for name, fn in (("vae", bench_vae), ("rev", bench_rev),
+                     ("sparse", bench_sparse), ("kernels", bench_kernels)):
+        try:
+            out["configs"][name] = fn(args)
+        except Exception as e:
+            out["configs"][name] = {
+                "error": f"{type(e).__name__}: {e}",
+                "trace": traceback.format_exc(limit=3)}
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true",
                     help="tiny model for CPU smoke runs (not a benchmark)")
-    ap.add_argument("--config", default="north",
-                    choices=["north", "vae", "rev", "sparse"])
+    ap.add_argument("--config", default="all",
+                    choices=["all", "north", "vae", "rev", "sparse",
+                             "kernels"])
     ap.add_argument("--attn", default="auto",
                     choices=["auto", "xla", "flash"])
     ap.add_argument("--steps", type=int, default=20)
@@ -409,8 +547,9 @@ def main():
                "attempts": attempt + 1}, code=1)
 
     try:
-        {"north": bench_north, "vae": bench_vae, "rev": bench_rev,
-         "sparse": bench_sparse}[args.config](args)
+        _emit({"all": bench_all, "north": bench_north, "vae": bench_vae,
+               "rev": bench_rev, "sparse": bench_sparse,
+               "kernels": bench_kernels}[args.config](args))
     except SystemExit:
         raise
     except Exception as e:

@@ -219,21 +219,26 @@ def blockwise_attention_bwd(q, k, v, mask, dout, out, softmax_stats, *,
             mask = _pad_seq(mask, block_k, 1)
     inv_l = 1.0 / l_stat
     b, h, n, d = q.shape
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    doutf = dout.astype(jnp.float32)
-    D = jnp.sum(doutf * out.astype(jnp.float32), axis=-1)        # (b, h, n)
+    # MXU operands stay in the INPUT dtype (bf16 in training — full-rate
+    # systolic passes; f32 in exactness tests) with f32 ACCUMULATION via
+    # preferred_element_type; softmax reconstruction and the ds chain stay
+    # f32 throughout. An all-f32 bwd ran the MXU at 1/3 rate for nothing —
+    # the probabilities are exp() outputs with bf16-scale information.
+    cdt = q.dtype
+    doutc = dout.astype(cdt)
+    D = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1)                                         # (b, h, n)
     rows = jnp.arange(n)
 
     num_k = n // block_k
 
     def step(dq, ik):
-        ks = lax.dynamic_slice_in_dim(kf, ik * block_k, block_k, axis=2)
-        vs = lax.dynamic_slice_in_dim(vf, ik * block_k, block_k, axis=2)
+        ks = lax.dynamic_slice_in_dim(k, ik * block_k, block_k, axis=2)
+        vs = lax.dynamic_slice_in_dim(v, ik * block_k, block_k, axis=2)
         cols = ik * block_k + jnp.arange(block_k)
 
-        s = jnp.einsum("bhid,bhjd->bhij", qf, ks) * scale
+        s = jnp.einsum("bhid,bhjd->bhij", q, ks,
+                       preferred_element_type=jnp.float32) * scale
         live = None                           # entries whose s is not a
         if mask is not None:                  # constant fill substitution
             km = lax.dynamic_slice_in_dim(mask, ik * block_k, block_k,
@@ -251,18 +256,24 @@ def blockwise_attention_bwd(q, k, v, mask, dout, out, softmax_stats, *,
             s = jnp.where(struct[None, None], s, -jnp.inf)
 
         p = jnp.exp(s - m_stat[..., None]) * inv_l[..., None]  # (b,h,n,BK)
-        dv = jnp.einsum("bhij,bhid->bhjd", p, doutf)
-        dp = jnp.einsum("bhid,bhjd->bhij", doutf, vs)
+        dv = jnp.einsum("bhij,bhid->bhjd", p.astype(cdt), doutc,
+                        preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhid,bhjd->bhij", doutc, vs,
+                        preferred_element_type=jnp.float32)
         ds = p * (dp - D[..., None]) * scale
         # where s was REPLACED by the fill, no gradient reaches q·k (the
         # forward's jnp.where blocks it) — p still feeds dv, but ds is 0.
         if live is not None:
             ds = jnp.where(live, ds, 0.0)
-        dk = jnp.einsum("bhij,bhid->bhjd", ds, qf)
-        dq = dq + jnp.einsum("bhij,bhjd->bhid", ds, ks)
+        ds_c = ds.astype(cdt)
+        dk = jnp.einsum("bhij,bhid->bhjd", ds_c, q,
+                        preferred_element_type=jnp.float32)
+        dq = dq + jnp.einsum("bhij,bhjd->bhid", ds_c, ks,
+                             preferred_element_type=jnp.float32)
         return dq, (dk, dv)
 
-    dq, (dks, dvs) = lax.scan(step, jnp.zeros_like(qf), jnp.arange(num_k))
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq, (dks, dvs) = lax.scan(step, dq0, jnp.arange(num_k))
     dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, n, d)
     dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, n, d)
     if ragged:

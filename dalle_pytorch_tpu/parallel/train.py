@@ -63,15 +63,26 @@ def setup_sharded(params, optimizer, mesh: Mesh, param_specs=None,
             is_leaf=lambda x: isinstance(x, P))
         params = jax.tree.map(jax.device_put, params, shardings)
         if opt_state is not None:
-            # moment buffers mirror the param tree; reuse its shardings where
-            # shapes line up, replicate the scalar counters
-            flat_shard = jax.tree.leaves(shardings)
-            shapes = {s.shape: sh for s, sh in
-                      zip(jax.tree.leaves(params), flat_shard)}
+            # moment buffers mirror the param TREE (optax mu/nu subtrees have
+            # the params' exact structure): place each such subtree with the
+            # params' own sharding tree — matched positionally by path, never
+            # by array shape (two equal-shaped params with different specs
+            # must not collide) — and replicate everything else (counters).
+            p_struct = jax.tree.structure(params)
+            p_leaves = jax.tree.leaves(params)
+
+            def is_param_tree(x):
+                if jax.tree.structure(x) != p_struct:
+                    return False
+                return all(getattr(a, "shape", None) == b.shape
+                           for a, b in zip(jax.tree.leaves(x), p_leaves))
+
             opt_state = jax.tree.map(
-                lambda x: jax.device_put(
-                    x, shapes.get(getattr(x, "shape", None),
-                                  NamedSharding(mesh, P()))), opt_state)
+                lambda sub: (jax.tree.map(jax.device_put, sub, shardings)
+                             if is_param_tree(sub)
+                             else jax.device_put(
+                                 sub, NamedSharding(mesh, P()))),
+                opt_state, is_leaf=is_param_tree)
     if opt_state is None:
         opt_state = jax.jit(optimizer.init)(params)
     return params, opt_state

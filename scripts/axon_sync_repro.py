@@ -1,0 +1,68 @@
+"""Repro: ``jax.block_until_ready`` does not synchronize on the axon TPU
+platform (VERDICT r2 / ADVICE r2) — the experiment behind bench.py's
+host-fetch timing discipline.
+
+Times a chain of 20 dependent 4096^3 bf16 matmuls two ways:
+
+  1. ``block_until_ready`` only — on axon this returns while the remote
+     execution is still in flight, so the "measured" TFLOP/s exceeds the
+     chip's physical bf16 peak by orders of magnitude;
+  2. the same chain followed by a host fetch of one element (which is
+     data-dependent on the whole chain), giving a physically sane number.
+
+Run on the TPU machine: ``python scripts/axon_sync_repro.py``. If (1) is
+at or below peak, the platform bug is gone and bench.py's ``_fetch`` sync
+could be relaxed back to ``block_until_ready``.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 4096
+CHAIN = 20
+FLOPS = 2 * N**3 * CHAIN
+
+
+def chain(x):
+    for _ in range(CHAIN):
+        x = x @ x
+        x = x / jnp.sqrt(jnp.float32(N))  # keep values finite
+    return x
+
+
+def main():
+    print("backend:", jax.default_backend(), jax.devices())
+    x = jax.random.normal(jax.random.PRNGKey(0), (N, N), jnp.bfloat16)
+    f = jax.jit(chain)
+    y = f(x)
+    _ = float(np.asarray(y[0, 0]))            # compile + settle
+
+    t0 = time.perf_counter()
+    y = f(x)
+    jax.block_until_ready(y)
+    dt_block = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    y = f(x)
+    _ = float(np.asarray(y[0, 0]))
+    dt_fetch = time.perf_counter() - t0
+
+    print(f"block_until_ready: {dt_block*1e3:8.1f} ms  "
+          f"-> {FLOPS/dt_block/1e12:9.1f} TFLOP/s")
+    print(f"host fetch:        {dt_fetch*1e3:8.1f} ms  "
+          f"-> {FLOPS/dt_fetch/1e12:9.1f} TFLOP/s")
+    peak = 197.0  # v5e bf16
+    if FLOPS / dt_block / 1e12 > peak * 1.5:
+        print("CONFIRMED: block_until_ready returned before execution "
+              "finished (apparent TFLOP/s above physical peak) — timed "
+              "regions must end with a host fetch.")
+    else:
+        print("NOT reproduced: block_until_ready appears to synchronize "
+              "on this platform/version.")
+
+
+if __name__ == "__main__":
+    main()

@@ -173,3 +173,34 @@ def test_bare_transformer_param_specs_shard(key):
     assert specs["attn"]["out"]["w"] == P(None, "tp", None)
     assert specs["ff"]["w1"]["w"] == P(None, None, "tp")
     assert specs["ff"]["w2"]["w"] == P(None, "tp", None)
+
+
+def test_setup_sharded_optstate_by_path_not_shape():
+    """Restored opt-state moments follow each param's OWN spec even when two
+    params share a shape (VERDICT r2 item 7: the old shape-keyed lookup let
+    the last equal-shaped param's sharding win for both)."""
+    mesh = make_mesh({"tp": 2, "dp": 4})
+    params = {"a": jnp.ones((8, 16)), "b": jnp.ones((8, 16))}  # equal shapes
+    specs = {"a": P("tp", None), "b": P(None, "tp")}           # different specs
+    opt = optax.adam(1e-3)
+
+    # init path establishes the ground-truth placement
+    p_init, s_init = setup_sharded(jax.tree.map(jnp.copy, params), opt,
+                                   mesh, specs)
+    # restore path: host-side opt state placed from scratch
+    host_state = jax.device_get(s_init)
+    p2, s2 = setup_sharded(jax.tree.map(jnp.copy, params), opt, mesh,
+                           specs, opt_state=host_state)
+
+    adam_state = s2[0]
+    for moments in (adam_state.mu, adam_state.nu):
+        assert moments["a"].sharding.spec == P("tp", None)
+        assert moments["b"].sharding.spec == P(None, "tp")
+    # scalar counter replicated
+    assert adam_state.count.sharding.spec == P()
+    # and the step still runs with the restored state
+    step = make_train_step(lambda p, b, r: jnp.sum(p["a"]) + jnp.sum(p["b"]),
+                           opt)
+    batch = shard_batch(mesh, {"x": jnp.zeros((8, 1))})
+    p3, s3, loss = step(p2, s2, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
