@@ -53,42 +53,63 @@ def _branches(cfg):
     return f, g
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def _rev_sequence(cfg, train, params, x12, keys, sparse_flags, mask):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _rev_sequence(cfg, train, pattern, params, x12, keys, mask):
     """Scan the reversible blocks; returns final (y1, y2).
 
-    params: depth-stacked layer pytree. x12: (x1, x2) tuple. keys:
-    (depth, 2, key) dropout keys. sparse_flags: (depth,) bool.
+    ``pattern`` is a STATIC tuple of per-layer dense/sparse bools for one
+    period of the (periodic) pattern — params and keys arrive reshaped to
+    ``(depth/period, period, ...)`` and the period is unrolled in the scan
+    body, so the dense/sparse choice resolves at trace time with no
+    ``lax.cond`` (same rationale as ops.transformer's unrolled path: a
+    differentiated cond around a Pallas custom_vjp branch inside a deep
+    scan is pathological for XLA/Mosaic compile). ``pattern=None`` is the
+    aperiodic fallback: params/keys stay ``(depth, ...)`` with an extra
+    leading-axis traced flag array carried in ``keys`` — see
+    ``reversible_apply``. x12: (x1, x2) tuple.
     """
     f, g = _branches(cfg)
 
+    if pattern is None:
+        keys, sparse_flags = keys
+
+        def body(carry, xs):
+            x1, x2 = carry
+            lp, lkeys, is_sparse = xs
+            y1 = x1 + f(lp, x2, mask, is_sparse, lkeys[0], train)
+            y2 = x2 + g(lp, y1, lkeys[1], train)
+            return (y1, y2), None
+
+        (y1, y2), _ = lax.scan(body, x12, (params, keys, sparse_flags))
+        return y1, y2
+
     def body(carry, xs):
         x1, x2 = carry
-        lp, lkeys, is_sparse = xs
-        y1 = x1 + f(lp, x2, mask, is_sparse, lkeys[0], train)
-        y2 = x2 + g(lp, y1, lkeys[1], train)
-        return (y1, y2), None
+        lp, lkeys = xs
+        for i in range(len(pattern)):
+            lpi = jax.tree.map(lambda a: a[i], lp)
+            y1 = x1 + f(lpi, x2, mask, bool(pattern[i]), lkeys[i][0], train)
+            y2 = x2 + g(lpi, y1, lkeys[i][1], train)
+            x1, x2 = y1, y2
+        return (x1, x2), None
 
-    (y1, y2), _ = lax.scan(body, x12, (params, keys, sparse_flags))
+    (y1, y2), _ = lax.scan(body, x12, (params, keys))
     return y1, y2
 
 
-def _rev_fwd(cfg, train, params, x12, keys, sparse_flags, mask):
-    y12 = _rev_sequence(cfg, train, params, x12, keys, sparse_flags, mask)
+def _rev_fwd(cfg, train, pattern, params, x12, keys, mask):
+    y12 = _rev_sequence(cfg, train, pattern, params, x12, keys, mask)
     # Save only the OUTPUT — no per-layer activations (the whole point;
     # reference reversible.py:114 saves only ctx.y).
-    return y12, (params, y12, keys, sparse_flags, mask)
+    return y12, (params, y12, keys, mask)
 
 
-def _rev_bwd(cfg, train, res, dy12):
-    params, (y1, y2), keys, sparse_flags, mask = res
+def _rev_bwd(cfg, train, pattern, res, dy12):
+    params, (y1, y2), keys, mask = res
     dy1, dy2 = dy12
     f, g = _branches(cfg)
 
-    def body(carry, xs):
-        y1, y2, dy1, dy2 = carry
-        lp, lkeys, is_sparse = xs
-
+    def block_bwd(lp, lkeys, is_sparse, y1, y2, dy1, dy2):
         # Invert g: x2 = y2 - g(y1); cotangents through g into (lp, y1).
         g_val, g_vjp = jax.vjp(lambda p, h: g(p, h, lkeys[1], train), lp, y1)
         x2 = y2 - g_val
@@ -104,12 +125,37 @@ def _rev_bwd(cfg, train, res, dy12):
         dx1 = dy1
 
         dp = jax.tree.map(jnp.add, dp_g, dp_f)
-        return (x1, x2, dx1, dx2), dp
+        return x1, x2, dx1, dx2, dp
+
+    if pattern is None:
+        keys, sparse_flags = keys
+
+        def body(carry, xs):
+            y1, y2, dy1, dy2 = carry
+            lp, lkeys, is_sparse = xs
+            x1, x2, dx1, dx2, dp = block_bwd(lp, lkeys, is_sparse,
+                                             y1, y2, dy1, dy2)
+            return (x1, x2, dx1, dx2), dp
+
+        (x1, x2, dx1, dx2), dparams = lax.scan(
+            body, (y1, y2, dy1, dy2), (params, keys, sparse_flags),
+            reverse=True)
+        return dparams, (dx1, dx2), (None, None), None
+
+    def body(carry, xs):
+        y1, y2, dy1, dy2 = carry
+        lp, lkeys = xs
+        dps = [None] * len(pattern)
+        for i in reversed(range(len(pattern))):    # invert in reverse order
+            lpi = jax.tree.map(lambda a: a[i], lp)
+            y1, y2, dy1, dy2, dps[i] = block_bwd(
+                lpi, lkeys[i], bool(pattern[i]), y1, y2, dy1, dy2)
+        dp = jax.tree.map(lambda *xs: jnp.stack(xs, 0), *dps)
+        return (y1, y2, dy1, dy2), dp
 
     (x1, x2, dx1, dx2), dparams = lax.scan(
-        body, (y1, y2, dy1, dy2), (params, keys, sparse_flags), reverse=True)
-
-    return dparams, (dx1, dx2), None, None, None
+        body, (y1, y2, dy1, dy2), (params, keys), reverse=True)
+    return dparams, (dx1, dx2), None, None
 
 
 _rev_sequence.defvjp(_rev_fwd, _rev_bwd)
@@ -128,7 +174,15 @@ def reversible_apply(params: dict, x: Array, *, cfg,
     """
     from dalle_pytorch_tpu.ops import transformer as T
     keys = T._layer_keys(rng, cfg.depth)
-    sparse_flags = jnp.asarray(cfg.sparse_pattern)
-    y1, y2 = _rev_sequence(cfg, train, params, (x, x), keys, sparse_flags,
-                           mask)
+    pattern = cfg.sparse_pattern
+    layout = T.unrolled_layout(params, keys, pattern)
+
+    if layout is not None:
+        stacked, keys_r, period_pat = layout
+        y1, y2 = _rev_sequence(cfg, train, period_pat, stacked, (x, x),
+                               keys_r, mask)
+    else:
+        sparse_flags = jnp.asarray(pattern)
+        y1, y2 = _rev_sequence(cfg, train, None, params, (x, x),
+                               (keys, sparse_flags), mask)
     return (y1 + y2) * 0.5

@@ -9,9 +9,11 @@ stack the TPU way:
   * layer parameters are **stacked** on a leading depth axis and the stack
     runs as one ``lax.scan`` — one compiled layer body regardless of depth,
     which is what keeps XLA compile time and code size flat at depth 64;
-  * mixed dense/sparse patterns (e.g. the reference's
-    ``sparse_attn=(True, False)*32``) run in the same scan with a
-    ``lax.cond`` on a per-layer flag;
+  * mixed dense/sparse patterns resolve STATICALLY when periodic (the
+    reference's ``sparse_attn=(True, False)*32``, period 2): the stack is
+    reshaped to (depth/period, period, ...) and the period unrolled in the
+    scan body, so no ``lax.cond`` is traced at all; aperiodic patterns
+    (period > 4) fall back to a per-layer ``lax.cond`` on a traced flag;
   * ``reversible=True`` swaps the scan for the O(1)-activation-memory
     ``custom_vjp`` engine in ops.reversible (reference reversible.py:54-157);
   * ``remat='full'`` applies ``jax.checkpoint`` to the scanned body —
@@ -102,9 +104,10 @@ def transformer_init(key: Array, cfg: TransformerConfig,
 def attn_branch(layer_params: dict, x: Array, mask: Optional[Array],
                 cfg: TransformerConfig, is_sparse, key: Optional[Array],
                 train: bool) -> Array:
-    """PreNorm attention. ``is_sparse`` may be a traced bool scalar — when the
-    pattern is mixed, both branches are compiled once and selected per layer
-    with lax.cond."""
+    """PreNorm attention. ``is_sparse`` is a static python bool when the
+    caller resolved the dense/sparse choice at trace time (the periodic-
+    pattern scan below), or a traced bool scalar — then both branches are
+    compiled once and selected per layer with lax.cond."""
     p = layer_params["attn"]
     h = core.layernorm(p["ln"], x)
 
@@ -151,6 +154,8 @@ def attn_branch(layer_params: dict, x: Array, mask: Optional[Array],
 
     if all(pattern):
         return sparse_fn(h)
+    if isinstance(is_sparse, bool):           # statically resolved per layer
+        return sparse_fn(h) if is_sparse else dense_fn(h)
     return lax.cond(is_sparse, sparse_fn, dense_fn, h)
 
 
@@ -169,6 +174,38 @@ def ff_branch(layer_params: dict, x: Array, cfg: TransformerConfig,
 # ---------------------------------------------------------------------------
 # apply
 # ---------------------------------------------------------------------------
+
+# largest dense/sparse pattern period the scan body statically unrolls;
+# longer (aperiodic) patterns fall back to the traced lax.cond selection
+_MAX_UNROLL_PERIOD = 4
+
+
+def _pattern_period(pattern: Tuple[bool, ...]) -> int:
+    """Smallest p with pattern == pattern[:p] * (len/p)."""
+    depth = len(pattern)
+    for p in range(1, depth + 1):
+        if depth % p == 0 and pattern == pattern[:p] * (depth // p):
+            return p
+    return depth
+
+
+def unrolled_layout(params, keys, pattern):
+    """(stacked params, stacked keys, one period of the pattern) when the
+    dense/sparse pattern is periodic enough to unroll statically, else None.
+
+    Shared dispatch for both execution engines (sequential scan here,
+    reversible custom_vjp in ops.reversible): layer stacks reshape from
+    (depth, ...) to (depth/period, period, ...) so the scan body unrolls the
+    period with the dense/sparse choice resolved at trace time."""
+    period = _pattern_period(pattern)
+    if period > _MAX_UNROLL_PERIOD:
+        return None
+    nsteps = len(pattern) // period
+    stacked = jax.tree.map(
+        lambda a: a.reshape(nsteps, period, *a.shape[1:]), params)
+    keys_r = keys.reshape(nsteps, period, *keys.shape[1:])
+    return stacked, keys_r, tuple(pattern[:period])
+
 
 def _layer_keys(rng: Optional[Array], depth: int) -> Array:
     if rng is None:
@@ -196,7 +233,34 @@ def transformer_apply(params: dict, x: Array, *, cfg: TransformerConfig,
                                 train=train)
 
     keys = _layer_keys(rng, cfg.depth)
-    sparse_flags = jnp.asarray(cfg.sparse_pattern)
+    pattern = cfg.sparse_pattern
+    layout = unrolled_layout(params, keys, pattern)
+
+    if layout is not None:
+        # Periodic dense/sparse patterns (the reference's (True, False)*32,
+        # transformer.py:155-158, has period 2) resolve STATICALLY — no
+        # lax.cond at all. A differentiated cond between a Pallas
+        # custom_vjp branch and a dense branch inside a 64-step scan is
+        # brutal on XLA/Mosaic compile time; this path keeps one compiled
+        # super-layer regardless of depth.
+        stacked, keys_r, period_pat = layout
+
+        def body(carry, xs):
+            lp, lkeys = xs
+            h = carry
+            for i, is_sparse in enumerate(period_pat):
+                lpi = jax.tree.map(lambda a: a[i], lp)
+                h = h + attn_branch(lpi, h, mask, cfg, bool(is_sparse),
+                                    lkeys[i][0], train)
+                h = h + ff_branch(lpi, h, cfg, lkeys[i][1], train)
+            return h, None
+
+        if cfg.remat == "full":
+            body = jax.checkpoint(body)
+        out, _ = lax.scan(body, x, (stacked, keys_r))
+        return out
+
+    sparse_flags = jnp.asarray(pattern)
 
     def body(carry, xs):
         lp, lkeys, is_sparse = xs
