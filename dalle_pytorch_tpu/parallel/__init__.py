@@ -11,6 +11,8 @@ parallelism over long sequences.
 
 from dalle_pytorch_tpu.parallel.mesh import (  # noqa: F401
     make_mesh, named_sharding, replicate, shard_batch)
+from dalle_pytorch_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_transformer)
 from dalle_pytorch_tpu.parallel.ring import (  # noqa: F401
     ring_attention, ulysses_attention)
 from dalle_pytorch_tpu.parallel.train import make_train_step  # noqa: F401

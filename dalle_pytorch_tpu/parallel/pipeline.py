@@ -1,0 +1,146 @@
+"""Pipeline parallelism: GPipe-style microbatched transformer over a ``pp``
+mesh axis.
+
+The reference has no pipeline (or any) parallelism (SURVEY.md §2.12/§2b);
+this is new TPU-native design: the depth-stacked layer tree is sharded so
+each of the P pipeline stages holds ``depth/P`` consecutive layers, the
+batch splits into M microbatches, and activations flow stage-to-stage with
+``lax.ppermute`` over ICI inside one ``shard_map`` program. The schedule is
+the classic (M + P - 1)-tick pipeline: at tick t, stage s runs microbatch
+``t - s`` (when in range) through its layer slice; XLA overlaps each tick's
+neighbor transfer with compute.
+
+Everything is a single jit-compiled SPMD program — no userland send/recv
+runtime — and it is differentiable end to end: the scan-over-ticks
+transposes into the reverse pipeline schedule and the ``ppermute`` into the
+reverse rotation.
+
+Composes with data parallelism by sharding the microbatch dimension over a
+``dp`` axis of the same mesh (``dp_axis=``); tensor/sequence parallelism
+apply within a stage exactly as without pp.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map            # jax >= 0.8
+except ImportError:                      # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+Array = jax.Array
+
+
+def _stage_pattern(cfg, num_stages: int):
+    """Per-stage sparse pattern — must be identical across stages (the
+    stage body is one SPMD program; a stage-dependent pattern would need a
+    traced cond, which the static-unroll design deliberately avoids)."""
+    depth_per = cfg.depth // num_stages
+    pattern = cfg.sparse_pattern
+    slices = {pattern[s * depth_per:(s + 1) * depth_per]
+              for s in range(num_stages)}
+    if len(slices) != 1:
+        raise ValueError(
+            f"sparse pattern {pattern} is not stage-invariant over "
+            f"{num_stages} pipeline stages of {depth_per} layers — every "
+            "stage must see the same dense/sparse slice")
+    return next(iter(slices))
+
+
+def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
+                         axis: str = "pp",
+                         num_microbatches: Optional[int] = None,
+                         dp_axis: Optional[str] = None,
+                         mask: Optional[Array] = None) -> Array:
+    """Run the transformer stack pipelined over ``mesh.shape[axis]`` stages.
+
+    params: depth-stacked layer tree (leading axis ``cfg.depth``).
+    x: (b, n, dim); b must divide into ``num_microbatches`` (default = the
+    stage count P; more microbatches shrink the P-1-tick bubble).
+    mask: optional (b, n) pad mask, routed to attention per microbatch.
+    dp_axis: additionally shard the microbatch dimension over this mesh
+    axis (pipeline x data parallel in one program).
+
+    Returns the same (b, n, dim) as ``transformer_apply`` on one device —
+    parity-tested on the CPU mesh. Eval semantics (dropout inert, as with
+    ``train=False``); ``reversible=True`` is rejected (different math).
+    """
+    from dalle_pytorch_tpu.ops.transformer import transformer_apply
+
+    num_stages = mesh.shape[axis]
+    if cfg.depth % num_stages:
+        raise ValueError(f"depth {cfg.depth} not divisible by pipeline "
+                         f"stages {num_stages}")
+    if cfg.reversible:
+        # the reversible engine's two-stream math differs from the plain
+        # stack — running it as sequential stages would silently change the
+        # function; pp + reversible is a future combination
+        raise NotImplementedError(
+            "pipeline_transformer does not support reversible=True")
+    depth_per = cfg.depth // num_stages
+    # eval semantics: dropout rates in the config are inert (no train path),
+    # exactly as transformer_apply(train=False)
+    stage_cfg = dataclasses.replace(
+        cfg, depth=depth_per, sparse_attn=_stage_pattern(cfg, num_stages))
+
+    M = num_microbatches or num_stages
+    b, n, d = x.shape
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by {M} microbatches")
+    mb = b // M
+
+    # stage-major layer stack: (P, depth/P, ...), stage axis sharded on pp
+    stacked = jax.tree.map(
+        lambda a: a.reshape(num_stages, depth_per, *a.shape[1:]), params)
+    xm = x.reshape(M, mb, n, d)
+    has_mask = mask is not None
+    maskm = (mask.reshape(M, mb, n) if has_mask
+             else jnp.ones((M, 1, 1), bool))              # dead placeholder
+
+    def stage_fn(stage_params, xm, maskm):
+        sp = jax.tree.map(lambda a: a[0], stage_params)   # local layer slice
+        P_ = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        ticks = M + P_ - 1
+        # pad the input stream so ticks beyond M feed (ignored) zeros
+        pad = jnp.zeros((P_ - 1, *xm.shape[1:]), xm.dtype)
+        stream = jnp.concatenate([xm, pad], axis=0)
+        # the microbatch at this stage at tick t is t - idx: pre-gather each
+        # tick's pad mask per stage (clipped; out-of-range ticks are idle
+        # and their outputs never selected)
+        masks = jax.vmap(
+            lambda t: maskm[jnp.clip(t - idx, 0, M - 1)])(jnp.arange(ticks))
+
+        def tick(state, xs):
+            inp, m_in = xs
+            # stage 0 ingests the next microbatch; others use the handoff
+            h = jnp.where(idx == 0, inp, state)
+            m = m_in if has_mask else None
+            out = transformer_apply(sp, h, cfg=stage_cfg, mask=m)
+            nxt = lax.ppermute(out, axis,
+                               [(i, (i + 1) % P_) for i in range(P_)])
+            return nxt, out
+
+        # the carry is device-varying over pp (each stage holds a different
+        # microbatch's activations) — mark the zero init accordingly
+        state0 = lax.pcast(jnp.zeros_like(xm[0]), (axis,), to="varying")
+        _, outs = lax.scan(tick, state0, (stream[:ticks], masks))
+        # stage s finishes microbatch m at tick m + s: the last stage's
+        # outputs at ticks P-1 .. M+P-2 are the final activations, in order
+        final = outs[P_ - 1:]
+        final = jnp.where(idx == P_ - 1, final, jnp.zeros_like(final))
+        return lax.psum(final, axis)                      # select last stage
+
+    data_spec = P(None, dp_axis) if dp_axis else P()
+    mask_spec = data_spec if has_mask else P()    # placeholder: replicate
+    out = shard_map(stage_fn, mesh=mesh,
+                    in_specs=(P(axis), data_spec, mask_spec),
+                    out_specs=data_spec)(stacked, xm, maskm)
+    return out.reshape(b, n, d)

@@ -1,0 +1,65 @@
+"""North-star tuning sweep (VERDICT r2 item 9): measure train
+tokens/sec/chip and MFU for the depth-12 dim-512 DALLE across attention
+impls and batch sizes on the real chip, host-synced timing. Prints one JSON
+line per point plus a best-config summary; use it to pick bench defaults.
+
+Run: python scripts/tune_north.py [--steps N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=15)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--attns", default="xla,flash")
+    ap.add_argument("--batches", default="8,16,32")
+    args = ap.parse_args()
+
+    import jax
+
+    from bench import (_bf16_peak, build_cfg, dalle_train_flops_per_token,
+                       setup_train, time_steps)
+    from dalle_pytorch_tpu.parallel import make_mesh
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"dp": n_dev})
+    peak = _bf16_peak()
+    results = []
+    for attn in args.attns.split(","):
+        for batch in (int(b) for b in args.batches.split(",")):
+            cfg = build_cfg(False, depth=12, attn_impl=attn)
+            t0 = time.time()
+            try:
+                step, params, opt_state, data, key = setup_train(
+                    cfg, batch, mesh)
+                dt, loss, _ = time_steps(step, params, opt_state, data, key,
+                                         args.warmup, args.steps)
+            except Exception as e:
+                print(json.dumps({"attn": attn, "batch": batch,
+                                  "error": f"{type(e).__name__}: {e}"}),
+                      flush=True)
+                continue
+            tps = args.steps * batch * cfg.seq_len / dt / n_dev
+            mfu = tps * dalle_train_flops_per_token(cfg) / peak
+            rec = {"attn": attn, "batch": batch,
+                   "tokens_sec_chip": round(tps, 1), "mfu": round(mfu, 4),
+                   "loss": round(loss, 4),
+                   "setup_s": round(time.time() - t0 - dt, 1)}
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+
+    if results:
+        best = max(results, key=lambda r: r["tokens_sec_chip"])
+        print(json.dumps({"best": best}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
