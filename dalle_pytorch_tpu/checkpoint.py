@@ -60,7 +60,15 @@ def save(path: str, params, *, step: int = 0, config: Any = None,
          opt_state=None, kind: str = "model", meta: Optional[dict] = None
          ) -> str:
     """Write a checkpoint directory atomically (tmp dir + rename), so a
-    killed writer never leaves a half-checkpoint that resume would trust."""
+    killed writer never leaves a half-checkpoint that resume would trust.
+
+    Multi-host: only process 0 writes (params are replicated under the dp
+    meshes the CLIs build, so it holds the full tree); other processes
+    return the path untouched — racing writers on a shared filesystem
+    would corrupt the atomic-rename protocol."""
+    from dalle_pytorch_tpu.parallel.multihost import is_primary
+    if not is_primary():
+        return path
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
     tmp = tempfile.mkdtemp(dir=parent, prefix=".ckpt-tmp-")

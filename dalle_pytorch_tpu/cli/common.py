@@ -63,6 +63,14 @@ def add_common_args(parser: argparse.ArgumentParser,
                         help="data-parallel devices (0 = all available)")
     parser.add_argument("--profile_dir", type=str, default="",
                         help="capture a jax.profiler trace here")
+    parser.add_argument("--coordinator", type=str, default="",
+                        help="multi-host: coordinator address host:port "
+                             "(or set JAX_COORDINATOR_ADDRESS); on TPU pods "
+                             "autodetected")
+    parser.add_argument("--num_processes", type=int, default=0,
+                        help="multi-host: total process count")
+    parser.add_argument("--process_id", type=int, default=-1,
+                        help="multi-host: this process's id")
     parser.add_argument("--nan_checks", action="store_true",
                         help="enable jax NaN/Inf trapping (slow)")
     parser.add_argument("--metrics", type=str, default="",
@@ -70,14 +78,31 @@ def add_common_args(parser: argparse.ArgumentParser,
 
 
 def setup_run(args, unit_name: str = "tokens"):
-    """-> (mesh, MetricsLogger, StepProfiler). Applies NaN toggles/seeding."""
+    """-> (mesh, MetricsLogger, StepProfiler). Applies NaN toggles/seeding.
+
+    Joins the multi-host cluster first when configured (flags or env —
+    parallel.multihost), so the mesh below spans every host's devices."""
+    from dalle_pytorch_tpu.parallel.multihost import initialize
+    initialize(coordinator_address=args.coordinator or None,
+               num_processes=args.num_processes or None,
+               process_id=args.process_id if args.process_id >= 0 else None)
     if args.nan_checks:
         enable_nan_checks(True)
     np.random.seed(args.seed)
     n = args.dp or len(jax.devices())
+    if jax.process_count() > 1 and n != len(jax.devices()):
+        # every process must own devices in the mesh and join the same
+        # computation — a --dp subset would exclude some hosts' chips and
+        # deadlock at the first collective
+        raise SystemExit(
+            f"--dp {args.dp} is not supported in multi-host mode: the mesh "
+            f"must span all {len(jax.devices())} global devices")
     mesh = make_mesh({"dp": n}, jax.devices()[:n])
+    # the train loops feed MetricsLogger host-LOCAL units, so the per-chip
+    # denominator is this host's share of the mesh
     metrics = MetricsLogger(args.metrics or None,
-                            log_interval=args.log_interval, n_devices=n)
+                            log_interval=args.log_interval,
+                            n_devices=n // jax.process_count())
     profiler = StepProfiler(args.profile_dir or None)
     os.makedirs(args.models_dir, exist_ok=True)
     os.makedirs(args.results_dir, exist_ok=True)

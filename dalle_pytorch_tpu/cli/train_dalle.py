@@ -130,7 +130,9 @@ def main(argv=None):
     # -- data --------------------------------------------------------------
     vocab, data = load_caption_data(args.captions_only, args.captions,
                                     args.text_seq_len)
-    vocab.save(os.path.join(args.models_dir, f"{args.name}-vocab.json"))
+    from dalle_pytorch_tpu.parallel.multihost import is_primary
+    if is_primary():                  # one writer on shared filesystems
+        vocab.save(os.path.join(args.models_dir, f"{args.name}-vocab.json"))
     data = list(shard_for_host(data))
     print(f"{len(data)} caption/image pairs on this host")
     dataset = CaptionDataset(data, batch_size=args.batchSize, shuffle=True,
@@ -189,9 +191,13 @@ def main(argv=None):
 
         if args.sample_every and (epoch + 1) % args.sample_every == 0:
             # sample from the last minibatch's captions (reference :215-217)
-            k = min(4, last_text.shape[0])
+            # — allgathered so all hosts feed the sampler identically (see
+            # train_vae's grid path)
+            from dalle_pytorch_tpu.parallel.multihost import fetch_local
+            texts = fetch_local(last_text)
+            k = min(4, texts.shape[0])
             images = D.generate_images(
-                params, vae_params, jnp.asarray(last_text[:k]), cfg=cfg,
+                params, vae_params, jnp.asarray(texts[:k]), cfg=cfg,
                 rng=jax.random.fold_in(key, 10_000 + epoch))
             out = os.path.join(args.results_dir,
                                f"{args.name}_dalle_epoch_{epoch}.png")

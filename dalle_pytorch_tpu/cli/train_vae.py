@@ -168,9 +168,13 @@ def main(argv=None):
             temperature *= dk
             print("Current temperature: ", temperature)
 
-        # per-epoch recon grid (input | recon | argmax decode), first 8
+        # per-epoch recon grid (input | recon | argmax decode), first 8.
+        # fetch_local: the batch is dp-sharded across (possibly) hosts —
+        # allgather the k rows so every process feeds the jit identical
+        # data (SPMD) and np.asarray never touches non-addressable shards
+        from dalle_pytorch_tpu.parallel.multihost import fetch_local
         k = min(8, args.batchSize)
-        imgs = last_batch["images"][:k]
+        imgs = jnp.asarray(fetch_local(last_batch["images"])[:k])
         recons, decoded = eval_fn(params, imgs,
                                   jax.random.fold_in(key, epoch),
                                   jnp.float32(temperature))

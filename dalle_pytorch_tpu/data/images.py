@@ -128,7 +128,10 @@ def to_uint8(images: np.ndarray, normalize: bool = True) -> np.ndarray:
 def save_image_grid(images: np.ndarray, path: str, nrow: int = 8,
                     normalize: bool = True, padding: int = 2) -> None:
     """Tile (b, H, W, C) into a row-major grid PNG — the save_image
-    equivalent for recon grids and samples."""
+    equivalent for recon grids and samples. Multi-host: process 0 only."""
+    from dalle_pytorch_tpu.parallel.multihost import is_primary
+    if not is_primary():
+        return
     _require_pil()
     x = to_uint8(images, normalize=normalize)
     b, h, w, c = x.shape

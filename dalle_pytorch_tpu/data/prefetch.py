@@ -65,7 +65,12 @@ class Prefetcher:
             for batch in it:
                 if self._transform is not None:
                     batch = self._transform(batch)
-                if self._sharding is not None:
+                # multi-host: keep batches on the HOST — shard_batch
+                # assembles the global array from each process's local data
+                # (a premature local device_put would just be pulled back)
+                if jax.process_count() > 1:
+                    pass
+                elif self._sharding is not None:
                     batch = jax.tree.map(
                         lambda x: jax.device_put(x, self._sharding), batch)
                 else:

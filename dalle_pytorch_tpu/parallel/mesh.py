@@ -54,6 +54,21 @@ def replicate(mesh: Mesh, tree):
 
 
 def shard_batch(mesh: Mesh, batch, axis: str = "dp"):
-    """Shard every leaf's leading (batch) dim over ``axis``."""
+    """Shard every leaf's leading (batch) dim over ``axis``.
+
+    Multi-host aware: in a multi-process cluster each host passes its OWN
+    (host-local) slice of the global batch — the data layer already feeds
+    every host different examples (data.prefetch host sharding) — and the
+    leaves assemble into one global array of leading dim
+    ``local_batch * process_count`` via
+    ``jax.make_array_from_process_local_data``. Single-process (the common
+    case and every test) is a plain ``device_put``, which would be WRONG
+    across processes: it treats each host's local array as the global one,
+    silently training on half-dropped, mismatched data.
+    """
     s = NamedSharding(mesh, P(axis))
-    return jax.device_put(batch, s)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, s)
+    return jax.tree.map(
+        lambda a: jax.make_array_from_process_local_data(
+            s, np.asarray(a)), batch)

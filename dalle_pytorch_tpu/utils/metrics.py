@@ -26,13 +26,17 @@ class MetricsLogger:
         """``n_devices`` is the number of chips actually participating in
         the training mesh (NOT all local devices — a --dp subset must not
         deflate the per-chip rate). Defaults to jax.device_count()."""
-        self.path = path
+        # multi-host: only process 0 prints and writes the JSONL (every
+        # host sees the same replicated loss; racing appends interleave)
+        from dalle_pytorch_tpu.parallel.multihost import is_primary
+        self.primary = is_primary()
+        self.path = path if self.primary else None
         self.log_interval = log_interval
         self.n_devices = n_devices
         self._t_last = time.perf_counter()
         self._units_since = 0
-        if path:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if self.path:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
 
     def step(self, step: int, loss: float, *, epoch: Optional[int] = None,
              units: int = 0, unit_name: str = "tokens", **extra) -> None:
@@ -57,9 +61,10 @@ class MetricsLogger:
         self._t_last = now
         self._units_since = 0
         head = f"epoch {epoch} " if epoch is not None else ""
-        print(f"{head}step {step}  loss {rec['loss']:.6f}  "
-              f"{rec[f'{unit_name}_per_sec_per_chip']:.1f} "
-              f"{unit_name}/s/chip", flush=True)
+        if self.primary:
+            print(f"{head}step {step}  loss {rec['loss']:.6f}  "
+                  f"{rec[f'{unit_name}_per_sec_per_chip']:.1f} "
+                  f"{unit_name}/s/chip", flush=True)
         if self.path:
             with open(self.path, "a") as f:
                 f.write(json.dumps(rec) + "\n")
