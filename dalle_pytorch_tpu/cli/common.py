@@ -21,6 +21,15 @@ from dalle_pytorch_tpu.utils import MetricsLogger, StepProfiler, \
     enable_nan_checks
 
 
+def say(*parts, **kw) -> None:
+    """print() on process 0 only — multi-host pods otherwise echo every
+    epoch summary/progress line once per host, interleaved (MetricsLogger
+    already gates its per-step output the same way)."""
+    from dalle_pytorch_tpu.parallel.multihost import is_primary
+    if is_primary():
+        print(*parts, **kw)
+
+
 def resolve_resume(name_or_path: str, models_dir: str, start_epoch: int):
     """Resolve a --loadVAE/--load_dalle value to (checkpoint path,
     start_epoch). A directory path is used as-is; a name with

@@ -38,7 +38,7 @@ import optax
 
 from dalle_pytorch_tpu import checkpoint as ckpt
 from dalle_pytorch_tpu.cli.common import (add_common_args, resolve_resume,
-                                          setup_run)
+                                          say, setup_run)
 from dalle_pytorch_tpu.data import (CaptionDataset, load_caption_data,
                                     load_image_batch, prefetch,
                                     save_image_grid, shard_for_host)
@@ -93,7 +93,7 @@ def main(argv=None):
 
     # -- VAE (frozen tokenizer/decoder) — the cross-CLI contract ----------
     vae_path = ckpt.ckpt_path(args.models_dir, args.vaename, args.vae_epoch)
-    print(f"loading VAE from {vae_path}")
+    say(f"loading VAE from {vae_path}")
     vae_params, vae_manifest = ckpt.restore_params(vae_path)
     vae_cfg = ckpt.vae_config_from_manifest(vae_manifest)
 
@@ -119,7 +119,7 @@ def main(argv=None):
                                            start_epoch)
         params, opt_state, manifest = ckpt.restore_train(path, optimizer)
         cfg = ckpt.dalle_config_from_manifest(manifest)
-        print(f"resumed DALLE from {path}")
+        say(f"resumed DALLE from {path}")
     else:
         # ties image_emb to the VAE codebook (reference dalle_pytorch.py:283)
         params = D.dalle_init(key, cfg, vae_params=vae_params)
@@ -134,7 +134,7 @@ def main(argv=None):
     if is_primary():                  # one writer on shared filesystems
         vocab.save(os.path.join(args.models_dir, f"{args.name}-vocab.json"))
     data = list(shard_for_host(data))
-    print(f"{len(data)} caption/image pairs on this host")
+    say(f"{len(data)} caption/image pairs on this host")
     dataset = CaptionDataset(data, batch_size=args.batchSize, shuffle=True,
                              seed=args.seed)
 
@@ -179,7 +179,7 @@ def main(argv=None):
             raise RuntimeError("empty dataset epoch")
 
         avg = train_loss / n_batches
-        print(f"====> Epoch: {epoch} Average loss: {avg:.4f}")
+        say(f"====> Epoch: {epoch} Average loss: {avg:.4f}")
         path = ckpt.save(
             ckpt.ckpt_path(args.models_dir, f"{args.name}_dalle", epoch),
             params, step=epoch, config=cfg, opt_state=opt_state,

@@ -33,7 +33,7 @@ import optax
 
 from dalle_pytorch_tpu import checkpoint as ckpt
 from dalle_pytorch_tpu.cli.common import (add_common_args, resolve_resume,
-                                          setup_run)
+                                          say, setup_run)
 from dalle_pytorch_tpu.data import ImageFolderDataset, prefetch, \
     save_image_grid, shard_for_host
 from dalle_pytorch_tpu.models import vae as V
@@ -116,7 +116,7 @@ def main(argv=None):
         params, opt_state, manifest = ckpt.restore_train(path, optimizer)
         cfg = ckpt.vae_config_from_manifest(manifest)
         temperature = manifest["meta"].get("temperature", temperature)
-        print(f"resumed VAE from {path}")
+        say(f"resumed VAE from {path}")
     else:
         params = V.vae_init(key, cfg)
 
@@ -132,7 +132,7 @@ def main(argv=None):
 
     dk = 0.7 ** (1.0 / max(len(dataset), 1))
     if args.tempsched:
-        print("Scale Factor:", dk)
+        say("Scale Factor:", dk)
 
     @jax.jit
     def eval_fn(params, images, rng, temperature):
@@ -166,7 +166,7 @@ def main(argv=None):
 
         if args.tempsched:
             temperature *= dk
-            print("Current temperature: ", temperature)
+            say("Current temperature: ", temperature)
 
         # per-epoch recon grid (input | recon | argmax decode), first 8.
         # fetch_local: the batch is dp-sharded across (possibly) hosts —
@@ -185,7 +185,7 @@ def main(argv=None):
         save_image_grid(grid, grid_path, nrow=k)
 
         avg = train_loss / n_batches
-        print(f"====> Epoch: {epoch} Average loss: {avg:.8f}")
+        say(f"====> Epoch: {epoch} Average loss: {avg:.8f}")
         path = ckpt.save(
             ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
             step=epoch, config=cfg, opt_state=opt_state, kind="vae",

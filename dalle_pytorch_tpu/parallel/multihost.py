@@ -59,8 +59,12 @@ def initialize(coordinator_address: Optional[str] = None,
 
     if coord is None and nproc is None:
         # bare single-process run (the common laptop/test case): stay local
-        # unless we're visibly on a pod (TPU pod env autodetects)
-        if not os.environ.get("TPU_WORKER_HOSTNAMES"):
+        # unless we're visibly on a multi-worker pod (TPU pod env
+        # autodetects). A single entry in TPU_WORKER_HOSTNAMES is one host
+        # (some runtimes set it to "localhost" even on a single chip) —
+        # nothing to join.
+        hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        if len([h for h in hosts.split(",") if h.strip()]) <= 1:
             return False
 
     try:

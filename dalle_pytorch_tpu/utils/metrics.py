@@ -30,6 +30,9 @@ class MetricsLogger:
         # host sees the same replicated loss; racing appends interleave)
         from dalle_pytorch_tpu.parallel.multihost import is_primary
         self.primary = is_primary()
+        # the train loops feed host-LOCAL units; per-host work is equalized
+        # by data.shard_for_host, so the global rate is local_rate × hosts
+        self.process_count = jax.process_count()
         self.path = path if self.primary else None
         self.log_interval = log_interval
         self.n_devices = n_devices
@@ -51,7 +54,7 @@ class MetricsLogger:
         n_dev = max(self.n_devices or jax.device_count(), 1)
         rec = {
             "step": step, "loss": float(loss),
-            f"{unit_name}_per_sec": round(rate, 2),
+            f"{unit_name}_per_sec": round(rate * self.process_count, 2),
             f"{unit_name}_per_sec_per_chip": round(rate / n_dev, 2),
             "time": time.time(),
         }
