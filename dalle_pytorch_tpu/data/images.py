@@ -33,6 +33,35 @@ def _require_pil():
         raise ImportError("PIL is required for image IO")
 
 
+_NATIVE_EXTS = {".png", ".jpg", ".jpeg"}
+
+
+def _native_loader():
+    """The C++ threaded decoder (native/loader.cc), or None when it can't
+    build/load or DALLE_TPU_NATIVE_LOADER=0. Decode output matches the PIL
+    path (exact for decode, within PIL's 8-bit rounding for resize)."""
+    if os.environ.get("DALLE_TPU_NATIVE_LOADER", "1") == "0":
+        return None
+    from dalle_pytorch_tpu import native
+    return native.load_image_batch_native if native.available() else None
+
+
+def _load_batch_fast(paths: Sequence[str],
+                     image_size: Optional[int]) -> Optional[np.ndarray]:
+    """Batch-decode via the native loader when every file is JPEG/PNG;
+    None -> caller uses the PIL path."""
+    if not paths or any(os.path.splitext(p)[1].lower() not in _NATIVE_EXTS
+                        for p in paths):
+        return None
+    fn = _native_loader()
+    if fn is None:
+        return None
+    try:
+        return fn(list(paths), image_size or 0)
+    except RuntimeError:
+        return None  # e.g. CMYK jpeg corner case: PIL path decides
+
+
 def load_image(path: str, image_size: Optional[int] = None) -> np.ndarray:
     """-> (H, W, 3) float32 in [-1, 1]."""
     _require_pil()
@@ -53,13 +82,16 @@ def load_image_batch(paths: Sequence[str], data_path: str = "",
     trainDALLE.py:185 'images are expected to be in ./imagefolder/0/').
     Absolute paths and paths that already exist are used as-is.
     """
-    out = []
+    full_paths = []
     for p in paths:
         full = p
         if not os.path.isabs(p) and not os.path.exists(p):
             full = os.path.join(data_path, subdir, p)
-        out.append(load_image(full, image_size))
-    return np.stack(out)
+        full_paths.append(full)
+    fast = _load_batch_fast(full_paths, image_size)
+    if fast is not None:
+        return fast
+    return np.stack([load_image(p, image_size) for p in full_paths])
 
 
 def list_image_folder(root: str) -> List[str]:
@@ -105,8 +137,13 @@ class ImageFolderDataset:
             idx = order[b * self.batch_size:(b + 1) * self.batch_size]
             if len(idx) < self.batch_size:  # wrap ragged tail
                 idx = np.concatenate([idx, order[:self.batch_size - len(idx)]])
-            yield np.stack([load_image(self.files[i], self.image_size)
-                            for i in idx])
+            batch_paths = [self.files[i] for i in idx]
+            fast = _load_batch_fast(batch_paths, self.image_size)
+            if fast is not None:
+                yield fast
+            else:
+                yield np.stack([load_image(p, self.image_size)
+                                for p in batch_paths])
 
     def __iter__(self):
         return self.epoch(0)

@@ -1,0 +1,107 @@
+"""Native (C++) runtime components, bound over a plain C ABI via ctypes.
+
+`load_image_batch_native` is the hot data-path entry: threaded JPEG/PNG
+decode + bilinear resize + [-1,1] normalize into one float32 NHWC array —
+the role torchvision's C++ IO plays for the reference (reference
+trainDALLE.py:185-187 `read_image(...)/255.`, trainVAE.py:59-67 transform
+stack), plus host-side parallelism the reference's serial per-image Python
+loop lacks (SURVEY.md §3.2).
+
+The library is built lazily on first use (g++, -ljpeg -lpng) and the data
+layer falls back to the PIL path when unavailable, so the framework never
+hard-requires a toolchain at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_lib_err: Optional[str] = None
+
+
+def load_library(build_if_missing: bool = True):
+    """dlopen the native loader, compiling it first if needed. Returns the
+    ctypes library or raises RuntimeError (sticky: a failed build is
+    remembered for the process)."""
+    global _lib, _lib_err
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _lib_err is not None:
+            raise RuntimeError(_lib_err)
+        try:
+            from dalle_pytorch_tpu.native.build import LIB, build
+            path = LIB
+            if build_if_missing or not os.path.exists(path):
+                path = build(quiet=True)
+            lib = ctypes.CDLL(path)
+            lib.dtl_load_images.restype = ctypes.c_int
+            lib.dtl_load_images.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+                ctypes.c_char_p, ctypes.c_int]
+            lib.dtl_probe.restype = ctypes.c_int
+            lib.dtl_probe.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int), ctypes.c_char_p, ctypes.c_int]
+            _lib = lib
+            return _lib
+        except Exception as e:
+            _lib_err = f"native loader unavailable: {e}"
+            raise RuntimeError(_lib_err) from e
+
+
+def available() -> bool:
+    """True when the native loader can be (or has been) loaded."""
+    try:
+        load_library()
+        return True
+    except RuntimeError:
+        return False
+
+
+def load_image_batch_native(paths: Sequence[str], image_size: int = 0,
+                            threads: int = 0) -> np.ndarray:
+    """Decode ``paths`` (JPEG/PNG) -> (n, S, S, 3) float32 in [-1, 1].
+
+    ``image_size=0`` skips resizing (all files must be square and equal
+    size). ``threads=0`` uses the host's core count. Raises RuntimeError
+    with the first file's error when any decode fails — batch loading is
+    all-or-nothing like the reference's loop (a bad file there raises from
+    ``read_image``, reference trainDALLE.py:185).
+    """
+    lib = load_library()
+    n = len(paths)
+    if n == 0:
+        return np.zeros((0, max(image_size, 0), max(image_size, 0), 3),
+                        np.float32)
+    size = image_size
+    if size <= 0:
+        w = ctypes.c_int()
+        h = ctypes.c_int()
+        err = ctypes.create_string_buffer(512)
+        if lib.dtl_probe(paths[0].encode(), ctypes.byref(w), ctypes.byref(h),
+                         err, len(err)) != 0:
+            raise RuntimeError(err.value.decode(errors="replace"))
+        size = w.value
+    out = np.empty((n, size, size, 3), np.float32)
+    c_paths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
+    err = ctypes.create_string_buffer(512)
+    rc = lib.dtl_load_images(
+        c_paths, n, image_size, threads,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), err, len(err))
+    if rc != 0:
+        raise RuntimeError(
+            f"{-rc}/{n} images failed to decode: "
+            f"{err.value.decode(errors='replace')}")
+    return out
+
+
+__all__ = ["available", "load_library", "load_image_batch_native"]

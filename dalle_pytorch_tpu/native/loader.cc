@@ -1,0 +1,330 @@
+// Native data loader: threaded JPEG/PNG decode + bilinear resize +
+// normalize, producing the float32 NHWC [-1, 1] batches the models consume.
+//
+// This is the TPU-host runtime equivalent of the native IO path the
+// reference reaches through torchvision (`torchvision.io.read_image`,
+// reference trainDALLE.py:185-187, and the ImageFolder/transforms stack,
+// reference trainVAE.py:59-67): image decode there is libjpeg/libpng C++
+// inside torchvision; here it is the same C libraries driven directly, plus
+// a std::thread pool so a many-core TPU host can decode a global batch
+// while the chip runs the previous step (the reference's loop decodes
+// serially on the Python side, SURVEY.md §3.2 "data-pipeline bottleneck").
+//
+// C ABI (ctypes-friendly, no CPython dependency):
+//   dtl_load_images(paths, n, image_size, threads, out, err, errlen) -> int
+//     paths       : array of n NUL-terminated file paths
+//     image_size  : output side S (square); 0 = no resize (files must then
+//                   all match the first file's dimensions)
+//     out         : caller-allocated n*S*S*3 float32, filled NHWC in [-1,1]
+//     returns 0 on success; on failure, a negative count of failed files
+//     with the first error message in err.
+//
+// Build: g++ -O3 -shared -fPIC loader.cc -o _loader.so -ljpeg -lpng -pthread
+// (driven by dalle_pytorch_tpu/native/build.py).
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <csetjmp>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+#include <png.h>
+
+namespace {
+
+struct Decoded {
+  std::vector<unsigned char> rgb;  // HWC, 3 channels
+  int w = 0, h = 0;
+};
+
+// ---------------------------------------------------------------------------
+// JPEG (libjpeg with longjmp error trap — its default handler exit()s)
+// ---------------------------------------------------------------------------
+
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+  char msg[JMSG_LENGTH_MAX];
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  (*cinfo->err->format_message)(cinfo, err->msg);
+  longjmp(err->jump, 1);
+}
+
+bool decode_jpeg(FILE* f, Decoded* out, std::string* err) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jump)) {
+    *err = jerr.msg;
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;  // libjpeg expands grayscale/YCbCr
+  jpeg_start_decompress(&cinfo);
+  out->w = cinfo.output_width;
+  out->h = cinfo.output_height;
+  out->rgb.resize(size_t(out->w) * out->h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = out->rgb.data() +
+        size_t(cinfo.output_scanline) * out->w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// PNG (libpng, transformed to 8-bit RGB: palette/gray expanded, alpha
+// stripped, 16-bit reduced)
+// ---------------------------------------------------------------------------
+
+bool decode_png(FILE* f, Decoded* out, std::string* err) {
+  png_structp png = png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr,
+                                           nullptr, nullptr);
+  if (!png) { *err = "png_create_read_struct failed"; return false; }
+  png_infop info = png_create_info_struct(png);
+  if (!info) {
+    png_destroy_read_struct(&png, nullptr, nullptr);
+    *err = "png_create_info_struct failed";
+    return false;
+  }
+  if (setjmp(png_jmpbuf(png))) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    *err = "libpng decode error";
+    return false;
+  }
+  png_init_io(png, f);
+  png_read_info(png, info);
+  png_set_expand(png);            // palette -> rgb, gray<8 -> 8, tRNS -> alpha
+  png_set_strip_16(png);
+  png_set_strip_alpha(png);
+  png_set_gray_to_rgb(png);
+  png_read_update_info(png, info);
+  out->w = png_get_image_width(png, info);
+  out->h = png_get_image_height(png, info);
+  if (png_get_rowbytes(png, info) != size_t(out->w) * 3) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    *err = "unexpected png row size after transforms";
+    return false;
+  }
+  out->rgb.resize(size_t(out->w) * out->h * 3);
+  std::vector<png_bytep> rows(out->h);
+  for (int y = 0; y < out->h; ++y)
+    rows[y] = out->rgb.data() + size_t(y) * out->w * 3;
+  png_read_image(png, rows.data());
+  png_read_end(png, nullptr);
+  png_destroy_read_struct(&png, &info, nullptr);
+  return true;
+}
+
+bool decode_file(const char* path, Decoded* out, std::string* err) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) { *err = std::string("cannot open ") + path; return false; }
+  unsigned char magic[8] = {0};
+  size_t got = std::fread(magic, 1, 8, f);
+  std::rewind(f);
+  bool ok = false;
+  if (got >= 8 && png_sig_cmp(magic, 0, 8) == 0) {
+    ok = decode_png(f, out, err);
+  } else if (got >= 2 && magic[0] == 0xFF && magic[1] == 0xD8) {
+    ok = decode_jpeg(f, out, err);
+  } else {
+    *err = std::string("unsupported format (not JPEG/PNG): ") + path;
+  }
+  std::fclose(f);
+  if (!ok && !err->empty() && err->find(path) == std::string::npos)
+    *err += std::string(" (") + path + ")";
+  return ok;
+}
+
+// ---------------------------------------------------------------------------
+// Separable triangle-filter resize (the PIL/torchvision BILINEAR resample:
+// filter support scales with the downscale ratio, so minification
+// area-averages instead of aliasing like 2-tap bilinear) + [-1,1] normalize.
+// Computed in float32 throughout — no 8-bit intermediate, slightly *better*
+// than the PIL path it replaces.
+// ---------------------------------------------------------------------------
+
+struct FilterTaps {
+  std::vector<int> xmin;       // per output index: first input tap
+  std::vector<int> count;      // taps per output index
+  std::vector<float> weights;  // flattened [out][max_count]
+  int max_count = 0;
+};
+
+FilterTaps triangle_taps(int in_size, int out_size) {
+  FilterTaps t;
+  const double scale = double(in_size) / out_size;
+  const double fscale = std::max(scale, 1.0);
+  const double radius = fscale;  // bilinear filter support = 1.0
+  t.max_count = int(std::ceil(radius)) * 2 + 1;
+  t.xmin.resize(out_size);
+  t.count.resize(out_size);
+  t.weights.assign(size_t(out_size) * t.max_count, 0.0f);
+  for (int o = 0; o < out_size; ++o) {
+    const double center = (o + 0.5) * scale;
+    int x0 = std::max(0, int(center - radius + 0.5));
+    int x1 = std::min(in_size, int(center + radius + 0.5));
+    double sum = 0.0;
+    for (int x = x0; x < x1; ++x) {
+      double d = std::abs((x + 0.5 - center) / fscale);
+      double w = d < 1.0 ? 1.0 - d : 0.0;
+      t.weights[size_t(o) * t.max_count + (x - x0)] = float(w);
+      sum += w;
+    }
+    if (sum > 0.0)
+      for (int i = 0; i < x1 - x0; ++i)
+        t.weights[size_t(o) * t.max_count + i] /= float(sum);
+    t.xmin[o] = x0;
+    t.count[o] = x1 - x0;
+  }
+  return t;
+}
+
+void resize_normalize(const Decoded& img, int S, float* out) {
+  const FilterTaps tx = triangle_taps(img.w, S);
+  const FilterTaps ty = triangle_taps(img.h, S);
+  // pass 1: horizontal, uint8 (h, w, 3) -> float (h, S, 3)
+  std::vector<float> tmp(size_t(img.h) * S * 3);
+  for (int y = 0; y < img.h; ++y) {
+    const unsigned char* row = img.rgb.data() + size_t(y) * img.w * 3;
+    float* trow = tmp.data() + size_t(y) * S * 3;
+    for (int ox = 0; ox < S; ++ox) {
+      const float* w = &tx.weights[size_t(ox) * tx.max_count];
+      const unsigned char* p = row + size_t(tx.xmin[ox]) * 3;
+      float r = 0, g = 0, b = 0;
+      for (int i = 0; i < tx.count[ox]; ++i, p += 3) {
+        r += w[i] * p[0];
+        g += w[i] * p[1];
+        b += w[i] * p[2];
+      }
+      trow[ox * 3 + 0] = r;
+      trow[ox * 3 + 1] = g;
+      trow[ox * 3 + 2] = b;
+    }
+  }
+  // pass 2: vertical, (h, S, 3) -> (S, S, 3), normalized to [-1,1]
+  for (int oy = 0; oy < S; ++oy) {
+    const float* w = &ty.weights[size_t(oy) * ty.max_count];
+    float* orow = out + size_t(oy) * S * 3;
+    std::memset(orow, 0, size_t(S) * 3 * sizeof(float));
+    for (int i = 0; i < ty.count[oy]; ++i) {
+      const float* trow = tmp.data() + size_t(ty.xmin[oy] + i) * S * 3;
+      for (int x = 0; x < S * 3; ++x) orow[x] += w[i] * trow[x];
+    }
+    for (int x = 0; x < S * 3; ++x)
+      orow[x] = orow[x] * (2.0f / 255.0f) - 1.0f;
+  }
+}
+
+void copy_normalize(const Decoded& img, float* out) {
+  const size_t n = size_t(img.w) * img.h * 3;
+  for (size_t i = 0; i < n; ++i)
+    out[i] = img.rgb[i] * (2.0f / 255.0f) - 1.0f;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on full success, -k when k files failed (err holds the first
+// failure message). Successfully decoded files are written regardless.
+int dtl_load_images(const char** paths, int n, int image_size, int threads,
+                    float* out, char* err, int errlen) {
+  if (n <= 0) return 0;
+  int S = image_size;
+  Decoded first;
+  std::string first_err;
+  if (S <= 0) {  // no-resize mode: probe the first file for dimensions
+    if (!decode_file(paths[0], &first, &first_err)) {
+      if (err && errlen > 0) std::snprintf(err, errlen, "%s", first_err.c_str());
+      return -n;
+    }
+    S = first.w;
+    if (first.w != first.h) {
+      if (err && errlen > 0)
+        std::snprintf(err, errlen, "image_size=0 requires square images, "
+                      "got %dx%d (%s)", first.w, first.h, paths[0]);
+      return -n;
+    }
+  }
+
+  std::atomic<int> next{0}, failures{0};
+  std::mutex err_mu;
+  std::string first_failure;
+  const size_t stride = size_t(S) * S * 3;
+
+  auto worker = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      Decoded img;
+      std::string e;
+      if (!decode_file(paths[i], &img, &e)) {
+        failures.fetch_add(1);
+        std::lock_guard<std::mutex> lk(err_mu);
+        if (first_failure.empty()) first_failure = e;
+        std::memset(out + i * stride, 0, stride * sizeof(float));
+        continue;
+      }
+      if (image_size <= 0 && (img.w != S || img.h != S)) {
+        failures.fetch_add(1);
+        std::lock_guard<std::mutex> lk(err_mu);
+        if (first_failure.empty())
+          first_failure = std::string("size mismatch in no-resize mode: ") +
+                          paths[i];
+        std::memset(out + i * stride, 0, stride * sizeof(float));
+        continue;
+      }
+      if (img.w == S && img.h == S)
+        copy_normalize(img, out + i * stride);
+      else
+        resize_normalize(img, S, out + i * stride);
+    }
+  };
+
+  int t = threads > 0 ? threads
+                      : int(std::thread::hardware_concurrency());
+  t = std::max(1, std::min(t, n));
+  if (t == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(t);
+    for (int i = 0; i < t; ++i) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+
+  int fails = failures.load();
+  if (fails && err && errlen > 0)
+    std::snprintf(err, errlen, "%s", first_failure.c_str());
+  return -fails;
+}
+
+// Decode ONE image, returning its dimensions without pixel output — used by
+// the Python wrapper to validate files cheaply.
+int dtl_probe(const char* path, int* w, int* h, char* err, int errlen) {
+  Decoded img;
+  std::string e;
+  if (!decode_file(path, &img, &e)) {
+    if (err && errlen > 0) std::snprintf(err, errlen, "%s", e.c_str());
+    return -1;
+  }
+  *w = img.w;
+  *h = img.h;
+  return 0;
+}
+
+}  // extern "C"
