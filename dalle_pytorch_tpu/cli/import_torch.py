@@ -1,0 +1,100 @@
+"""Convert a reference DALLE-pytorch ``.pth`` into this framework's
+checkpoint format.
+
+The reference's cross-program contract is weight files written by its
+training scripts and read everywhere else (reference trainVAE.py:119,
+trainDALLE.py:64-67, genDALLE.py:51-52, mixVAEcuda.py:20-21). This CLI
+closes the migration path: a user's existing ``.pth`` becomes a checkpoint
+directory that train_vae/train_dalle/gen_dalle/mix_vae resume from
+directly.
+
+    python -m dalle_pytorch_tpu.cli.import_torch vae mytrained.pth \
+        --out ./models/vae-99 [--image_size 256]
+
+    python -m dalle_pytorch_tpu.cli.import_torch dalle dalle.pth \
+        --out ./models/dalle-0 [--heads 8] [--vae_out ./models/vae-0]
+
+For DALLE the embedded VAE (reference ties it into the DALLE state dict,
+dalle_pytorch.py:283) can be written as its own checkpoint too, so the
+whole pipeline is reconstructed from one file.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from dalle_pytorch_tpu import checkpoint as ckpt
+from dalle_pytorch_tpu.compat import (import_clip, import_dalle, import_vae,
+                                      load_torch_state_dict)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="import a reference DALLE-pytorch .pth checkpoint")
+    p.add_argument("kind", choices=["vae", "dalle", "clip"])
+    p.add_argument("pth", help="path to the torch state_dict file")
+    p.add_argument("--out", required=True,
+                   help="output checkpoint directory (e.g. models/vae-0)")
+    p.add_argument("--image_size", type=int, default=256,
+                   help="VAE training image size (not stored in weights)")
+    p.add_argument("--heads", type=int, default=8,
+                   help="attention heads (not inferable from weights)")
+    p.add_argument("--epoch", type=int, default=0,
+                   help="epoch number recorded in the checkpoint")
+    p.add_argument("--vae_out", default="",
+                   help="dalle only: also write the embedded VAE here")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    sd = load_torch_state_dict(args.pth)
+
+    if args.kind == "vae":
+        from dalle_pytorch_tpu.models.vae import VAEConfig
+        params, cfg_kw = import_vae(sd, image_size=args.image_size)
+        path = ckpt.save(args.out, params, step=args.epoch,
+                         config=VAEConfig(**cfg_kw), kind="vae",
+                         meta={"imported_from": args.pth,
+                               "epoch": args.epoch})
+        print(f"wrote VAE checkpoint {path} "
+              f"({cfg_kw['num_tokens']} tokens, {cfg_kw['num_layers']} "
+              "layers)")
+        return
+
+    if args.kind == "dalle":
+        from dalle_pytorch_tpu.models.dalle import DALLEConfig
+        from dalle_pytorch_tpu.models.vae import VAEConfig
+        params, vae_params, cfg_kw, vae_cfg_kw = import_dalle(
+            sd, image_size=args.image_size)
+        if vae_params is None:
+            raise SystemExit("this .pth has no embedded vae.* weights; "
+                             "import the VAE separately")
+        inner = cfg_kw.pop("dim_head") * 8     # stored assuming 8 heads
+        cfg = DALLEConfig(vae=VAEConfig(**vae_cfg_kw), heads=args.heads,
+                          dim_head=inner // args.heads, **cfg_kw)
+        path = ckpt.save(args.out, params, step=args.epoch, config=cfg,
+                         kind="dalle", meta={"imported_from": args.pth,
+                                             "epoch": args.epoch})
+        print(f"wrote DALLE checkpoint {path} (dim {cfg.dim}, depth "
+              f"{cfg.depth})")
+        if args.vae_out:
+            vpath = ckpt.save(args.vae_out, vae_params, step=args.epoch,
+                              config=VAEConfig(**vae_cfg_kw), kind="vae",
+                              meta={"imported_from": args.pth,
+                                    "epoch": args.epoch})
+            print(f"wrote embedded VAE checkpoint {vpath}")
+        return
+
+    from dalle_pytorch_tpu.models.clip import CLIPConfig
+    params, cfg_kw = import_clip(sd)
+    cfg = CLIPConfig(text_heads=args.heads, visual_heads=args.heads,
+                     **cfg_kw)
+    path = ckpt.save(args.out, params, step=args.epoch, config=cfg,
+                     kind="clip", meta={"imported_from": args.pth,
+                                        "epoch": args.epoch})
+    print(f"wrote CLIP checkpoint {path}")
+
+
+if __name__ == "__main__":
+    main()
