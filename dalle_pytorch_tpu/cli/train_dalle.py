@@ -83,6 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["xla", "flash"])
     p.add_argument("--sparse_impl", type=str, default="ref",
                    choices=["ref", "pallas"])
+    p.add_argument("--loss_chunk", type=int, default=0,
+                   help="stream the CE head over sequence chunks of this "
+                        "size (0 = dense); caps logits memory at "
+                        "(batch, chunk, vocab)")
     p.set_defaults(name="test")
     return p
 
@@ -105,7 +109,7 @@ def main(argv=None):
         dim_head=args.dim_head, reversible=args.reversible,
         attn_dropout=args.attn_dropout, ff_dropout=args.ff_dropout,
         sparse_attn=sparse, attn_impl=args.attn_impl,
-        sparse_impl=args.sparse_impl)
+        sparse_impl=args.sparse_impl, loss_chunk=args.loss_chunk)
 
     key = jax.random.PRNGKey(args.seed)
     optimizer = optax.adam(args.lr)

@@ -65,6 +65,13 @@ class DALLEConfig:
     # 'grid' factorizes over the token grid; 'full_image' reproduces the
     # reference's (image_size, image_size) table quirk.
     axial_compat: str = "grid"
+    # CE memory strategy: 0 computes the loss over the full (b, seq,
+    # total_tokens) logits; a positive value streams the head+CE over
+    # sequence chunks of that size under jax.checkpoint, so peak logits
+    # memory is (b, chunk, total_tokens) — the 12k-vocab head over seq 1280
+    # is otherwise the largest train-time buffer. Same loss, bitwise-close
+    # grads; one extra head matmul on the backward pass.
+    loss_chunk: int = 0
 
     @property
     def image_seq_len(self) -> int:
@@ -233,13 +240,11 @@ def dalle_apply(params: dict, text: Array, image=None, *, cfg: DALLEConfig,
     h = T.transformer_apply(params["transformer"], tokens,
                             cfg=cfg.transformer, mask=mask, rng=rng,
                             train=train)
-    logits = to_logits(params, h)
-
-    forbidden = logits_mask(cfg)[:seq_len]
-    logits = jnp.where(forbidden[None], core.neg_inf(logits.dtype), logits)
 
     if not return_loss:
-        return logits
+        logits = to_logits(params, h)
+        forbidden = logits_mask(cfg)[:seq_len]
+        return jnp.where(forbidden[None], core.neg_inf(logits.dtype), logits)
 
     if image_ids is None:
         raise ValueError("when training, image must be supplied")
@@ -248,9 +253,54 @@ def dalle_apply(params: dict, text: Array, image=None, *, cfg: DALLEConfig,
         [text, image_ids + cfg.num_text_tokens,
          jnp.full((text.shape[0], 1), cfg.eos_token_id, text.dtype)], axis=1)
     targets = labels[:, 1:]                      # predict token i+1 at row i
+
+    if cfg.loss_chunk > 0:
+        return _chunked_ce(params, h, targets, cfg)
+    logits = to_logits(params, h)
+    forbidden = logits_mask(cfg)[:seq_len]
+    logits = jnp.where(forbidden[None], core.neg_inf(logits.dtype), logits)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
+
+
+def _chunked_ce(params: dict, h: Array, targets: Array,
+                cfg: DALLEConfig) -> Array:
+    """Streamed head + cross-entropy: identical math to the dense path, but
+    the (chunk, total_tokens) logits exist only inside a rematerialized scan
+    body, so the full (b, seq, total_tokens) tensor is never resident.
+
+    The forbidden-position mask participates BEFORE the log_softmax (it
+    shapes the partition function, reference dalle_pytorch.py:391-396), so
+    it is applied per chunk, not folded into the gather."""
+    b, n, d = h.shape
+    chunk = min(cfg.loss_chunk, n)
+    pad = (-n) % chunk
+    valid = jnp.ones((n,), jnp.float32)
+    forbidden = logits_mask(cfg)[:n]
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        valid = jnp.pad(valid, (0, pad))
+        forbidden = jnp.pad(forbidden, ((0, pad), (0, 0)))
+    steps = (n + pad) // chunk
+
+    h_c = jnp.moveaxis(h.reshape(b, steps, chunk, d), 1, 0)
+    t_c = jnp.moveaxis(targets.reshape(b, steps, chunk), 1, 0)
+    f_c = forbidden.reshape(steps, chunk, -1)
+    v_c = valid.reshape(steps, chunk)
+
+    def body(acc, xs):
+        hc, tc, fc, vc = xs
+        logits = to_logits(params, hc)
+        logits = jnp.where(fc[None], core.neg_inf(logits.dtype), logits)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
+        return acc + jnp.sum(nll * vc[None]), None
+
+    total, _ = lax.scan(jax.checkpoint(body), jnp.float32(0.0),
+                        (h_c, t_c, f_c, v_c))
+    return total / (b * n)
 
 
 # ---------------------------------------------------------------------------
