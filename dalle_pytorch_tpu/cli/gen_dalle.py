@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dalle_pytorch_tpu import checkpoint as ckpt
+from dalle_pytorch_tpu.cli.common import say
 from dalle_pytorch_tpu.data import (Vocabulary, read_captions_only,
                                     save_image_grid)
 from dalle_pytorch_tpu.models import dalle as D
@@ -90,11 +91,11 @@ def main(argv=None):
     vae_params = jax.device_put(vae_params)
 
     vocab = load_vocab(args)
-    print(args.caption)
+    say(args.caption)
     codes = vocab.encode(args.caption,
                          pad_to=cfg.text_seq_len if args.pad_prompt
                          else None)
-    print(codes)
+    say(codes)
 
     text = jnp.asarray([codes] * args.num_images, jnp.int32)
 
@@ -117,17 +118,17 @@ def main(argv=None):
         images, scores = out
         order = np.argsort(-np.asarray(scores))    # best first
         images = np.asarray(images)[order]
-        print("clip scores (sorted):", np.asarray(scores)[order])
+        say("clip scores (sorted):", np.asarray(scores)[order])
     else:
         images = np.asarray(out)
 
     ts = int(time.time())
-    print(args.caption, ts)
+    say(args.caption, ts)
     path = os.path.join(
         args.results_dir,
         f"gendalle{args.name}_epoch_{args.dalle_epoch}-{ts}.png")
     save_image_grid(images, path, nrow=min(args.num_images, 8))
-    print(f"saved {path}")
+    say(f"saved {path}")
 
 
 if __name__ == "__main__":

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dalle_pytorch_tpu import checkpoint as ckpt
+from dalle_pytorch_tpu.cli.common import say
 from dalle_pytorch_tpu.data import ImageFolderDataset, save_image_grid
 from dalle_pytorch_tpu.models import vae as V
 
@@ -91,7 +92,7 @@ def main(argv=None):
             args.out_dir,
             f"mixed_epoch_{args.load_epoch}_{batch_idx}.png")
         save_image_grid(grid, out, nrow=k)
-        print(f"saved {out}")
+        say(f"saved {out}")
 
 
 if __name__ == "__main__":
