@@ -108,11 +108,21 @@ def main(argv=None):
         clip_kwargs = {"clip_params": clip_params,
                        "clip_cfg": CLIPConfig(**clip_manifest["config"])}
 
-    out = D.generate_images(
-        params, vae_params, text, cfg=cfg,
-        rng=jax.random.PRNGKey(args.seed),
-        filter_thres=args.filter_thres, temperature=args.temperature,
-        **clip_kwargs)
+    # ONE jit program (prefill + KV-cache decode scan + VAE decode [+ CLIP
+    # rerank]) — not per-op dispatch. clip_cfg is static (closed over);
+    # clip params are a traced pytree argument.
+    clip_cfg = clip_kwargs.pop("clip_cfg", None)
+
+    @jax.jit
+    def gen(p, vp, t, rng, clip_p):
+        kw = {} if clip_p is None else {"clip_params": clip_p,
+                                        "clip_cfg": clip_cfg}
+        return D.generate_images(p, vp, t, cfg=cfg, rng=rng,
+                                 filter_thres=args.filter_thres,
+                                 temperature=args.temperature, **kw)
+
+    out = gen(params, vae_params, text, jax.random.PRNGKey(args.seed),
+              clip_kwargs.get("clip_params"))
 
     if clip_kwargs:
         images, scores = out

@@ -139,6 +139,36 @@ class TestTrainDALLE:
                 if f.startswith("gendalletoy_epoch_0-")]
         assert outs, "gen_dalle wrote no PNG"
 
+    def test_gen_dalle_clip_rerank(self, workdir):
+        """--clip_name reranks the jitted sampler's output (reference
+        dalle_pytorch.py:354-356); scores print best-first and a grid is
+        still written."""
+        import jax
+        import jax.numpy as jnp
+        from dalle_pytorch_tpu.models import clip as C
+        ccfg = C.CLIPConfig(dim_text=16, dim_image=16, dim_latent=8,
+                            num_text_tokens=50, text_seq_len=8,
+                            text_enc_depth=1, visual_enc_depth=1,
+                            text_heads=2, visual_heads=2,
+                            visual_image_size=IMG, visual_patch_size=8,
+                            sparse_attn=False)
+        cparams = C.clip_init(jax.random.PRNGKey(3), ccfg)
+        ckpt.save(ckpt.ckpt_path(str(workdir / "models"), "clip", 0),
+                  cparams, step=0, config=ccfg, kind="clip")
+
+        from dalle_pytorch_tpu.cli.gen_dalle import main
+        main([
+            "a red square",
+            "--name", "toy", "--dalle_epoch", "0",
+            "--clip_name", "clip", "--clip_epoch", "0",
+            "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+            "--num_images", "2",
+        ])
+        outs = [f for f in os.listdir(workdir / "results")
+                if f.startswith("gendalletoy_epoch_0-")]
+        assert outs
+
     def test_gen_dalle_oov_raises(self, workdir):
         from dalle_pytorch_tpu.cli.gen_dalle import main
         with pytest.raises(KeyError):
