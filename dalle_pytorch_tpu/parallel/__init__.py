@@ -15,4 +15,6 @@ from dalle_pytorch_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_transformer)
 from dalle_pytorch_tpu.parallel.ring import (  # noqa: F401
     ring_attention, ulysses_attention)
+from dalle_pytorch_tpu.parallel.sequence import (  # noqa: F401
+    sp_dalle_loss_fn, sp_transformer_apply)
 from dalle_pytorch_tpu.parallel.train import make_train_step  # noqa: F401

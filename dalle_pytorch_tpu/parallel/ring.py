@@ -59,6 +59,43 @@ def _online_block(carry, kb, vb, q, scale, allow):
     return m_new, l, acc
 
 
+def ring_attention_local(q, k, v, *, axis: str, size: int,
+                         causal: bool = True,
+                         scale: Optional[float] = None):
+    """Per-shard ring attention body — call INSIDE a ``shard_map`` whose
+    mesh has axis ``axis`` of ``size``; q, k, v are the LOCAL (b, h, n/size,
+    d) sequence shards. Exposed separately so higher layers (the
+    sequence-parallel transformer stack in parallel.sequence) can fuse the
+    ring into their own shard_map instead of nesting one per attention."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    nl = q.shape[2]
+    rank = lax.axis_index(axis)
+    rows = rank * nl + jnp.arange(nl)
+
+    # init the accumulators FROM q so they carry the same device-varying
+    # type as the scan's rotating kb/vb under shard_map
+    m = q[..., :1] * 0.0 - jnp.inf
+    l = q[..., :1] * 0.0
+    acc = q * 0.0
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    def step(s, state):
+        m, l, acc, kb, vb = state
+        src = (rank - s) % size          # who produced the block we hold
+        cols = src * nl + jnp.arange(nl)
+        allow = (cols[None, :] <= rows[:, None]) if causal else \
+            jnp.ones((nl, nl), bool)
+        m, l, acc = _online_block((m, l, acc), kb, vb, q, scale, allow)
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        return m, l, acc, kb, vb
+
+    m, l, acc, _, _ = lax.fori_loop(
+        0, size, step, (m, l, acc, k, v), unroll=True)
+    return acc / jnp.where(l == 0.0, 1.0, l)
+
+
 def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
                    causal: bool = True, scale: Optional[float] = None,
                    batch_axis: Optional[str] = None):
@@ -68,36 +105,11 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
     Returns (b, h, n, d) sharded the same way. ``batch_axis`` optionally
     names a mesh axis the batch dim is sharded over (pure SPMD pass-through).
     """
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
     size = mesh.shape[axis]
 
     def local(q, k, v):
-        nl = q.shape[2]
-        rank = lax.axis_index(axis)
-        rows = rank * nl + jnp.arange(nl)
-
-        # init the accumulators FROM q so they carry the same device-varying
-        # type as the scan's rotating kb/vb under shard_map
-        m = q[..., :1] * 0.0 - jnp.inf
-        l = q[..., :1] * 0.0
-        acc = q * 0.0
-        perm = [(i, (i + 1) % size) for i in range(size)]
-
-        def step(s, state):
-            m, l, acc, kb, vb = state
-            src = (rank - s) % size          # who produced the block we hold
-            cols = src * nl + jnp.arange(nl)
-            allow = (cols[None, :] <= rows[:, None]) if causal else \
-                jnp.ones((nl, nl), bool)
-            m, l, acc = _online_block((m, l, acc), kb, vb, q, scale, allow)
-            kb = lax.ppermute(kb, axis, perm)
-            vb = lax.ppermute(vb, axis, perm)
-            return m, l, acc, kb, vb
-
-        m, l, acc, _, _ = lax.fori_loop(
-            0, size, step, (m, l, acc, k, v), unroll=True)
-        return acc / jnp.where(l == 0.0, 1.0, l)
+        return ring_attention_local(q, k, v, axis=axis, size=size,
+                                    causal=causal, scale=scale)
 
     spec = P(batch_axis, None, axis, None)
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
@@ -114,32 +126,41 @@ def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
     (all_to_all over ICI), attends over the FULL sequence for its heads,
     then swaps back.
     """
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
     size = mesh.shape[axis]
     if q.shape[1] % size != 0:
         raise ValueError(f"heads {q.shape[1]} not divisible by mesh axis "
                          f"{axis} ({size})")
 
     def local(q, k, v):
-        # local shapes: (b, h, nl, d) -> all_to_all -> (b, h/size, n, d)
-        def seq_to_heads(x):
-            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
-                                  tiled=True)
-
-        def heads_to_seq(x):
-            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
-                                  tiled=True)
-
-        qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-        s = jnp.einsum("bhid,bhjd->bhij", qh, kh) * scale
-        if causal:
-            n = s.shape[-1]
-            tri = jnp.tril(jnp.ones((n, n), bool))
-            s = jnp.where(tri[None, None], s, -jnp.inf)
-        out = jnp.einsum("bhij,bhjd->bhid", jax.nn.softmax(s, axis=-1), vh)
-        return heads_to_seq(out)
+        return ulysses_attention_local(q, k, v, axis=axis, causal=causal,
+                                       scale=scale)
 
     spec = P(batch_axis, None, axis, None)
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec)(q, k, v)
+
+
+def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True,
+                            scale: Optional[float] = None):
+    """Per-shard Ulysses body — call INSIDE a ``shard_map``; q, k, v are
+    LOCAL (b, h, n/size, d) shards with h divisible by the axis size."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    # local shapes: (b, h, nl, d) -> all_to_all -> (b, h/size, n, d)
+    def seq_to_heads(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    s = jnp.einsum("bhid,bhjd->bhij", qh, kh) * scale
+    if causal:
+        n = s.shape[-1]
+        tri = jnp.tril(jnp.ones((n, n), bool))
+        s = jnp.where(tri[None, None], s, -jnp.inf)
+    out = jnp.einsum("bhij,bhjd->bhid", jax.nn.softmax(s, axis=-1), vh)
+    return heads_to_seq(out)

@@ -1,0 +1,140 @@
+"""Sequence-parallel transformer: the full stack with the TOKEN axis
+sharded over a mesh axis — long-context training the reference cannot do at
+all (SURVEY.md §5.7: its only sequence-cost levers are single-device).
+
+Layout: activations are (batch, seq/sp, dim) per device; parameters are
+replicated over ``sp`` (shard them over dp/fsdp outside). LayerNorm, the
+qkv/out projections, and the GEGLU FF are position-local, so they need no
+communication; only attention mixes positions and it runs as either
+
+  * ``impl='ring'``   — K/V shards rotate neighbor-to-neighbor with
+    ``ppermute`` (bandwidth-optimal on an ICI ring) into an online-softmax
+    accumulator (parallel.ring.ring_attention_local), or
+  * ``impl='ulysses'`` — one all-to-all re-shards sequence -> heads, local
+    dense attention over the full sequence, all-to-all back.
+
+The whole stack is ONE ``shard_map`` (collectives inside a single compiled
+program, one ``lax.scan`` over the depth-stacked layer params) rather than
+a shard_map per attention call.
+
+Restrictions (asserted): dense attention only, no dropout, no pad mask —
+the DALLE training sequence is always the full text+image length
+(reference dalle_pytorch.py:384-388 pads the mask span to all-True over
+images; a genuinely padded text span would need a masked ring step).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dalle_pytorch_tpu.ops import attention as attn_ops
+from dalle_pytorch_tpu.ops import core
+from dalle_pytorch_tpu.ops import transformer as T
+from dalle_pytorch_tpu.parallel.ring import (ring_attention_local,
+                                             ulysses_attention_local)
+
+try:
+    from jax import shard_map            # jax >= 0.8
+except ImportError:                      # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def _check_cfg(cfg: T.TransformerConfig) -> None:
+    if any(cfg.sparse_pattern):
+        raise ValueError("sequence parallelism supports dense attention "
+                         "only (sparse_attn must be False)")
+    if cfg.reversible:
+        raise ValueError("sequence parallelism and reversible execution "
+                         "are mutually exclusive engines")
+    if cfg.attn_dropout or cfg.ff_dropout:
+        raise ValueError("dropout is not supported under sequence "
+                         "parallelism")
+
+
+def sp_transformer_apply(params, x, *, cfg: T.TransformerConfig, mesh: Mesh,
+                         sp_axis: str = "sp",
+                         batch_axis: Optional[str] = None,
+                         impl: str = "ring"):
+    """Run the stack with x (b, n, dim) sequence-sharded over ``sp_axis``.
+
+    Numerics match ``ops.transformer.transformer_apply`` (same prenorm
+    residual bodies, same ``cfg.scale``); only the attention communication
+    pattern differs. ``batch_axis`` optionally shards the batch dim too
+    (dp x sp in one mesh).
+    """
+    _check_cfg(cfg)
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sp impl {impl!r}")
+    size = mesh.shape[sp_axis]
+    if x.shape[1] % size != 0:
+        raise ValueError(f"seq len {x.shape[1]} not divisible by "
+                         f"{sp_axis} axis ({size})")
+
+    def attend(q, k, v):
+        if impl == "ring":
+            return ring_attention_local(q, k, v, axis=sp_axis, size=size,
+                                        causal=cfg.causal, scale=cfg.scale)
+        return ulysses_attention_local(q, k, v, axis=sp_axis,
+                                       causal=cfg.causal, scale=cfg.scale)
+
+    def local(params, x):
+        def body(h, lp):
+            a_in = core.layernorm(lp["attn"]["ln"], h)
+            q, k, v = attn_ops.qkv_project(lp["attn"], a_in, cfg.heads)
+            o = attend(q, k, v)
+            h = h + attn_ops.output_tail(lp["attn"], o)
+            h = h + T.ff_branch(lp, h, cfg, None, False)
+            return h, None
+
+        out, _ = lax.scan(body, x, params)
+        return out
+
+    x_spec = P(batch_axis, sp_axis, None)
+    return shard_map(local, mesh=mesh,
+                     in_specs=(P(), x_spec), out_specs=x_spec)(params, x)
+
+
+def sp_dalle_loss_fn(cfg, mesh: Mesh, *, sp_axis: str = "sp",
+                     batch_axis: Optional[str] = None, impl: str = "ring"):
+    """DALLE training loss with the transformer sequence-sharded.
+
+    Batch = {'text': (b, t) ids, 'image': (b, n_img) token ids}. Embedding
+    lookups and the CE head run under GSPMD (the embeddings inherit the
+    sequence sharding from the concat; use ``cfg.loss_chunk`` to also cap
+    the head's logits memory). Signature matches
+    ``parallel.train.make_train_step``'s ``loss_fn(params, batch, rng)``.
+    """
+    from dalle_pytorch_tpu.models import dalle as D
+    _check_cfg(cfg.transformer)
+
+    def loss(params, batch, rng):
+        text, image_ids = batch["text"], batch["image"]
+        tokens = D.embed_prompt(params, cfg, text, image_ids)
+        tokens = jax.lax.with_sharding_constraint(
+            tokens, NamedSharding(mesh, P(batch_axis, sp_axis, None)))
+        h = sp_transformer_apply(params["transformer"], tokens,
+                                 cfg=cfg.transformer, mesh=mesh,
+                                 sp_axis=sp_axis, batch_axis=batch_axis,
+                                 impl=impl)
+
+        labels = jnp.concatenate(
+            [text, image_ids + cfg.num_text_tokens,
+             jnp.full((text.shape[0], 1), cfg.eos_token_id, text.dtype)],
+            axis=1)
+        targets = labels[:, 1:]
+        if cfg.loss_chunk > 0:
+            return D._chunked_ce(params, h, targets, cfg)
+        logits = D.to_logits(params, h)
+        forbidden = D.logits_mask(cfg)[:h.shape[1]]
+        logits = jnp.where(forbidden[None], core.neg_inf(logits.dtype),
+                           logits)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    return loss
