@@ -83,6 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["xla", "flash"])
     p.add_argument("--sparse_impl", type=str, default="ref",
                    choices=["ref", "pallas"])
+    p.add_argument("--param_dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="dtype for NEW runs' params (resumed runs keep "
+                        "the checkpoint's dtype)")
     p.add_argument("--loss_chunk", type=int, default=0,
                    help="stream the CE head over sequence chunks of this "
                         "size (0 = dense); caps logits memory at "
@@ -126,7 +130,8 @@ def main(argv=None):
         say(f"resumed DALLE from {path}")
     else:
         # ties image_emb to the VAE codebook (reference dalle_pytorch.py:283)
-        params = D.dalle_init(key, cfg, vae_params=vae_params)
+        params = D.dalle_init(key, cfg, vae_params=vae_params,
+                              dtype=jnp.dtype(args.param_dtype))
 
     params, opt_state = setup_sharded(params, optimizer, mesh,
                                       opt_state=opt_state)

@@ -63,6 +63,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hidden_dim", type=int, default=128)
     p.add_argument("--num_resnet_blocks", type=int, default=0)
     p.add_argument("--straight_through", action="store_true")
+    p.add_argument("--param_dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="dtype for NEW runs' params (bfloat16 halves HBM "
+                        "and keeps every matmul on the MXU's native "
+                        "precision; resumed runs keep the checkpoint's "
+                        "dtype)")
     p.set_defaults(name="vae")
     return p
 
@@ -118,7 +124,7 @@ def main(argv=None):
         temperature = manifest["meta"].get("temperature", temperature)
         say(f"resumed VAE from {path}")
     else:
-        params = V.vae_init(key, cfg)
+        params = V.vae_init(key, cfg, dtype=jnp.dtype(args.param_dtype))
 
     params, opt_state = setup_sharded(params, optimizer, mesh,
                                       opt_state=opt_state)

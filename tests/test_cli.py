@@ -211,3 +211,18 @@ class TestResolveResume:
         from dalle_pytorch_tpu.cli.common import resolve_resume
         with pytest.raises(FileNotFoundError):
             resolve_resume("ghost", str(tmp_path), 0)
+
+
+@pytest.mark.slow
+class TestParamDtype:
+    def test_bf16_vae_trains_and_checkpoints(self, workdir, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        from dalle_pytorch_tpu.cli.train_vae import main
+        main(vae_args(workdir, ["--n_epochs", "1", "--param_dtype",
+                                "bfloat16", "--name", "vae16",
+                                "--models_dir", str(tmp_path)]))
+        path, _ = ckpt.latest(str(tmp_path), "vae16")
+        params, _ = ckpt.restore_params(path)
+        leaves = jax.tree.leaves(params)
+        assert all(leaf.dtype == jnp.bfloat16 for leaf in leaves)
