@@ -37,14 +37,21 @@ except ImportError:                      # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 
-def _online_block(carry, kb, vb, q, scale, allow):
+def _online_block(carry, kb, vb, q, scale, allow, pair_ok=None):
     """Fold one K/V block into the online-softmax state.
 
     carry: (m, l, acc) with m,l (b,h,nl,1) and acc (b,h,nl,d).
-    allow: (nl_q, nl_k) bool — True where attention is permitted.
+    allow: (nl_q, nl_k) bool — True where attention is permitted (causal).
+    pair_ok: optional (b, nl_q, nl_k) pad mask — False entries fill with
+    the FINITE -fmax (reference transformer.py:74-77), so a fully-padded
+    row degrades to a uniform average over its causal prefix exactly like
+    the dense path (ops.attention.dense_attention_weights).
     """
     m, l, acc = carry
     s = jnp.einsum("bhid,bhjd->bhij", q, kb) * scale
+    if pair_ok is not None:
+        fmax = jnp.asarray(-jnp.finfo(s.dtype).max, s.dtype)
+        s = jnp.where(pair_ok[:, None], s, fmax)
     neg = jnp.asarray(-jnp.inf, s.dtype)
     s = jnp.where(allow[None, None], s, neg)
 
@@ -52,7 +59,8 @@ def _online_block(carry, kb, vb, q, scale, allow):
     # rows with no allowed key yet keep m=-inf; shift with 0 to avoid nans
     shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
     p = jnp.exp(s - shift)
-    p = jnp.where(allow[None, None], p, 0.0)
+    p = jnp.where(allow[None, None], p, 0.0)   # causal zeros only; pad rows
+    #                                            keep their uniform exp(0)=1
     alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
     l = l * alpha + p.sum(axis=-1, keepdims=True)
     acc = acc * alpha + jnp.einsum("bhij,bhjd->bhid", p, vb)
@@ -61,12 +69,18 @@ def _online_block(carry, kb, vb, q, scale, allow):
 
 def ring_attention_local(q, k, v, *, axis: str, size: int,
                          causal: bool = True,
-                         scale: Optional[float] = None):
+                         scale: Optional[float] = None,
+                         mask=None):
     """Per-shard ring attention body — call INSIDE a ``shard_map`` whose
     mesh has axis ``axis`` of ``size``; q, k, v are the LOCAL (b, h, n/size,
     d) sequence shards. Exposed separately so higher layers (the
     sequence-parallel transformer stack in parallel.sequence) can fuse the
-    ring into their own shard_map instead of nesting one per attention."""
+    ring into their own shard_map instead of nesting one per attention.
+
+    ``mask`` is this shard's (b, n/size) pad mask; its blocks rotate around
+    the ring with k/v, and pad pairs fill with the finite -fmax so the
+    semantics match the dense path bit-for-bit (reference
+    transformer.py:74-77 pair mask)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     nl = q.shape[2]
@@ -79,46 +93,71 @@ def ring_attention_local(q, k, v, *, axis: str, size: int,
     l = q[..., :1] * 0.0
     acc = q * 0.0
     perm = [(i, (i + 1) % size) for i in range(size)]
+    q_mask = mask
 
     def step(s, state):
-        m, l, acc, kb, vb = state
+        m, l, acc, kb, vb, mb = state
         src = (rank - s) % size          # who produced the block we hold
         cols = src * nl + jnp.arange(nl)
         allow = (cols[None, :] <= rows[:, None]) if causal else \
             jnp.ones((nl, nl), bool)
-        m, l, acc = _online_block((m, l, acc), kb, vb, q, scale, allow)
+        pair_ok = None
+        if mb is not None:
+            pair_ok = q_mask[:, :, None] & mb[:, None, :]   # (b, nl, nl)
+        m, l, acc = _online_block((m, l, acc), kb, vb, q, scale, allow,
+                                  pair_ok)
         kb = lax.ppermute(kb, axis, perm)
         vb = lax.ppermute(vb, axis, perm)
-        return m, l, acc, kb, vb
+        if mb is not None:
+            mb = lax.ppermute(mb, axis, perm)
+        return m, l, acc, kb, vb, mb
 
-    m, l, acc, _, _ = lax.fori_loop(
-        0, size, step, (m, l, acc, k, v), unroll=True)
+    if mask is None:
+        # fori_loop needs a fixed-structure carry: run the maskless variant
+        def step_nomask(s, state):
+            m, l, acc, kb, vb = state
+            m, l, acc, kb, vb, _ = step(s, (m, l, acc, kb, vb, None))
+            return m, l, acc, kb, vb
+        m, l, acc, _, _ = lax.fori_loop(
+            0, size, step_nomask, (m, l, acc, k, v), unroll=True)
+    else:
+        m, l, acc, _, _, _ = lax.fori_loop(
+            0, size, step, (m, l, acc, k, v, mask), unroll=True)
     return acc / jnp.where(l == 0.0, 1.0, l)
 
 
 def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
                    causal: bool = True, scale: Optional[float] = None,
-                   batch_axis: Optional[str] = None):
+                   batch_axis: Optional[str] = None, mask=None):
     """Exact attention with the sequence axis sharded over ``axis``.
 
     q, k, v: (b, h, n, d) GLOBAL shapes; n divides by the axis size.
-    Returns (b, h, n, d) sharded the same way. ``batch_axis`` optionally
-    names a mesh axis the batch dim is sharded over (pure SPMD pass-through).
+    ``mask``: optional (b, n) global pad mask (True = keep), dense-path
+    semantics. Returns (b, h, n, d) sharded the same way. ``batch_axis``
+    optionally names a mesh axis the batch dim is sharded over (pure SPMD
+    pass-through).
     """
     size = mesh.shape[axis]
-
-    def local(q, k, v):
-        return ring_attention_local(q, k, v, axis=axis, size=size,
-                                    causal=causal, scale=scale)
-
     spec = P(batch_axis, None, axis, None)
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    m_spec = P(batch_axis, axis)
+
+    if mask is None:
+        def local(q, k, v):
+            return ring_attention_local(q, k, v, axis=axis, size=size,
+                                        causal=causal, scale=scale)
+        return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+    def local(q, k, v, mask):
+        return ring_attention_local(q, k, v, axis=axis, size=size,
+                                    causal=causal, scale=scale, mask=mask)
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, m_spec),
+                     out_specs=spec)(q, k, v, mask)
 
 
 def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
                       causal: bool = True, scale: Optional[float] = None,
-                      batch_axis: Optional[str] = None):
+                      batch_axis: Optional[str] = None, mask=None):
     """Exact attention via head<->sequence all-to-all re-sharding.
 
     q, k, v: (b, h, n, d) global; h divides by the axis size. Inside the
@@ -131,19 +170,30 @@ def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
         raise ValueError(f"heads {q.shape[1]} not divisible by mesh axis "
                          f"{axis} ({size})")
 
-    def local(q, k, v):
-        return ulysses_attention_local(q, k, v, axis=axis, causal=causal,
-                                       scale=scale)
-
     spec = P(batch_axis, None, axis, None)
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    m_spec = P(batch_axis, axis)
+
+    if mask is None:
+        def local(q, k, v):
+            return ulysses_attention_local(q, k, v, axis=axis,
+                                           causal=causal, scale=scale)
+        return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+    def local(q, k, v, mask):
+        return ulysses_attention_local(q, k, v, axis=axis, causal=causal,
+                                       scale=scale, mask=mask)
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, m_spec),
+                     out_specs=spec)(q, k, v, mask)
 
 
 def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True,
-                            scale: Optional[float] = None):
+                            scale: Optional[float] = None, mask=None):
     """Per-shard Ulysses body — call INSIDE a ``shard_map``; q, k, v are
-    LOCAL (b, h, n/size, d) shards with h divisible by the axis size."""
+    LOCAL (b, h, n/size, d) shards with h divisible by the axis size.
+    ``mask`` is this shard's (b, n/size) pad mask; it is all-gathered to
+    the full sequence (the heads are local here anyway) and applied with
+    dense-path semantics."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
 
@@ -158,6 +208,11 @@ def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True,
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     s = jnp.einsum("bhid,bhjd->bhij", qh, kh) * scale
+    if mask is not None:
+        full = lax.all_gather(mask, axis, axis=1, tiled=True)   # (b, n)
+        pair = full[:, :, None] & full[:, None, :]
+        fmax = jnp.asarray(-jnp.finfo(s.dtype).max, s.dtype)
+        s = jnp.where(pair[:, None], s, fmax)
     if causal:
         n = s.shape[-1]
         tri = jnp.tril(jnp.ones((n, n), bool))

@@ -17,10 +17,11 @@ The whole stack is ONE ``shard_map`` (collectives inside a single compiled
 program, one ``lax.scan`` over the depth-stacked layer params) rather than
 a shard_map per attention call.
 
-Restrictions (asserted): dense attention only, no dropout, no pad mask —
-the DALLE training sequence is always the full text+image length
-(reference dalle_pytorch.py:384-388 pads the mask span to all-True over
-images; a genuinely padded text span would need a masked ring step).
+Pad masks are supported with dense-path semantics: mask blocks rotate
+around the ring with k/v (pad pairs fill with the finite -fmax, so padded
+rows degrade to a causal-prefix average exactly like
+ops.attention.dense_attention_weights). Restrictions (asserted): dense
+attention only, no dropout.
 """
 
 from __future__ import annotations
@@ -59,13 +60,14 @@ def _check_cfg(cfg: T.TransformerConfig) -> None:
 def sp_transformer_apply(params, x, *, cfg: T.TransformerConfig, mesh: Mesh,
                          sp_axis: str = "sp",
                          batch_axis: Optional[str] = None,
-                         impl: str = "ring"):
+                         impl: str = "ring", mask=None):
     """Run the stack with x (b, n, dim) sequence-sharded over ``sp_axis``.
 
     Numerics match ``ops.transformer.transformer_apply`` (same prenorm
-    residual bodies, same ``cfg.scale``); only the attention communication
-    pattern differs. ``batch_axis`` optionally shards the batch dim too
-    (dp x sp in one mesh).
+    residual bodies, same ``cfg.scale``, same pad-mask semantics — ``mask``
+    is the (b, n) GLOBAL pad mask, sharded like the tokens); only the
+    attention communication pattern differs. ``batch_axis`` optionally
+    shards the batch dim too (dp x sp in one mesh).
     """
     _check_cfg(cfg)
     if impl not in ("ring", "ulysses"):
@@ -75,18 +77,20 @@ def sp_transformer_apply(params, x, *, cfg: T.TransformerConfig, mesh: Mesh,
         raise ValueError(f"seq len {x.shape[1]} not divisible by "
                          f"{sp_axis} axis ({size})")
 
-    def attend(q, k, v):
+    def attend(q, k, v, mb):
         if impl == "ring":
             return ring_attention_local(q, k, v, axis=sp_axis, size=size,
-                                        causal=cfg.causal, scale=cfg.scale)
+                                        causal=cfg.causal, scale=cfg.scale,
+                                        mask=mb)
         return ulysses_attention_local(q, k, v, axis=sp_axis,
-                                       causal=cfg.causal, scale=cfg.scale)
+                                       causal=cfg.causal, scale=cfg.scale,
+                                       mask=mb)
 
-    def local(params, x):
+    def stack(params, x, mb):
         def body(h, lp):
             a_in = core.layernorm(lp["attn"]["ln"], h)
             q, k, v = attn_ops.qkv_project(lp["attn"], a_in, cfg.heads)
-            o = attend(q, k, v)
+            o = attend(q, k, v, mb)
             h = h + attn_ops.output_tail(lp["attn"], o)
             h = h + T.ff_branch(lp, h, cfg, None, False)
             return h, None
@@ -95,18 +99,24 @@ def sp_transformer_apply(params, x, *, cfg: T.TransformerConfig, mesh: Mesh,
         return out
 
     x_spec = P(batch_axis, sp_axis, None)
-    return shard_map(local, mesh=mesh,
-                     in_specs=(P(), x_spec), out_specs=x_spec)(params, x)
+    m_spec = P(batch_axis, sp_axis)
+    if mask is None:
+        return shard_map(lambda p, x: stack(p, x, None), mesh=mesh,
+                         in_specs=(P(), x_spec), out_specs=x_spec)(params, x)
+    return shard_map(stack, mesh=mesh, in_specs=(P(), x_spec, m_spec),
+                     out_specs=x_spec)(params, x, mask)
 
 
 def sp_dalle_loss_fn(cfg, mesh: Mesh, *, sp_axis: str = "sp",
                      batch_axis: Optional[str] = None, impl: str = "ring"):
     """DALLE training loss with the transformer sequence-sharded.
 
-    Batch = {'text': (b, t) ids, 'image': (b, n_img) token ids}. Embedding
-    lookups and the CE head run under GSPMD (the embeddings inherit the
-    sequence sharding from the concat; use ``cfg.loss_chunk`` to also cap
-    the head's logits memory). Signature matches
+    Batch = {'text': (b, t) ids, 'image': (b, n_img) token ids, 'mask':
+    optional (b, t) text pad mask — extended all-True over the image span
+    exactly like the dense path (reference dalle_pytorch.py:384-388)}.
+    Embedding lookups and the CE head run under GSPMD (the embeddings
+    inherit the sequence sharding from the concat; use ``cfg.loss_chunk``
+    to also cap the head's logits memory). Signature matches
     ``parallel.train.make_train_step``'s ``loss_fn(params, batch, rng)``.
     """
     from dalle_pytorch_tpu.models import dalle as D
@@ -117,10 +127,14 @@ def sp_dalle_loss_fn(cfg, mesh: Mesh, *, sp_axis: str = "sp",
         tokens = D.embed_prompt(params, cfg, text, image_ids)
         tokens = jax.lax.with_sharding_constraint(
             tokens, NamedSharding(mesh, P(batch_axis, sp_axis, None)))
+        mask = batch.get("mask")
+        if mask is not None:
+            pad = jnp.ones((mask.shape[0], image_ids.shape[1]), bool)
+            mask = jnp.concatenate([mask, pad], axis=1)
         h = sp_transformer_apply(params["transformer"], tokens,
                                  cfg=cfg.transformer, mesh=mesh,
                                  sp_axis=sp_axis, batch_axis=batch_axis,
-                                 impl=impl)
+                                 impl=impl, mask=mask)
 
         labels = jnp.concatenate(
             [text, image_ids + cfg.num_text_tokens,
