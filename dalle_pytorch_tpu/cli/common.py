@@ -106,7 +106,11 @@ def setup_run(args, unit_name: str = "tokens"):
         raise SystemExit(
             f"--dp {args.dp} is not supported in multi-host mode: the mesh "
             f"must span all {len(jax.devices())} global devices")
-    mesh = make_mesh({"dp": n}, jax.devices()[:n])
+    sp = getattr(args, "sp", 0) or 1
+    if sp > 1 and n % sp:
+        raise SystemExit(f"--sp {sp} must divide the device count ({n})")
+    axes = {"dp": n // sp, "sp": sp} if sp > 1 else {"dp": n}
+    mesh = make_mesh(axes, jax.devices()[:n])
     # the train loops feed MetricsLogger host-LOCAL units, so the per-chip
     # denominator is this host's share of the mesh
     metrics = MetricsLogger(args.metrics or None,

@@ -83,6 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["xla", "flash"])
     p.add_argument("--sparse_impl", type=str, default="ref",
                    choices=["ref", "pallas"])
+    p.add_argument("--sp", type=int, default=0,
+                   help="sequence-parallel mesh axis size (devices split "
+                        "dp x sp; requires zero dropout; the token axis "
+                        "shards over sp with ring attention)")
+    p.add_argument("--sp_impl", default="ring", choices=["ring", "ulysses"])
     p.add_argument("--param_dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="dtype for NEW runs' params (resumed runs keep "
@@ -97,6 +102,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.sp and args.sp > 1 and (args.attn_dropout or args.ff_dropout):
+        raise SystemExit("--sp requires --attn_dropout 0 --ff_dropout 0")
     mesh, metrics, profiler = setup_run(args)
 
     # -- VAE (frozen tokenizer/decoder) — the cross-CLI contract ----------
@@ -154,13 +161,20 @@ def main(argv=None):
         images = load_image_batch(paths, args.dataPath, args.imageSize)
         return {"text": toks, "images": images}
 
-    def loss_fn(params, batch, rng):
-        # all-True mask, matching the reference's training call
-        # (trainDALLE.py:192); image ids are precomputed outside the step
-        mask = jnp.ones_like(batch["text"], bool)
-        return D.dalle_apply(params, batch["text"], batch["image"], cfg=cfg,
-                             mask=mask, rng=rng, train=True,
-                             return_loss=True)
+    if args.sp and args.sp > 1:
+        # sequence-parallel training: the token axis shards over the sp
+        # mesh axis, ring/Ulysses attention inside one shard_map
+        from dalle_pytorch_tpu.parallel import sp_dalle_loss_fn
+        loss_fn = sp_dalle_loss_fn(cfg, mesh, batch_axis="dp",
+                                   impl=args.sp_impl)
+    else:
+        def loss_fn(params, batch, rng):
+            # all-True mask, matching the reference's training call
+            # (trainDALLE.py:192); image ids are precomputed outside the step
+            mask = jnp.ones_like(batch["text"], bool)
+            return D.dalle_apply(params, batch["text"], batch["image"],
+                                 cfg=cfg, mask=mask, rng=rng, train=True,
+                                 return_loss=True)
 
     step = make_train_step(loss_fn, optimizer)
 
