@@ -83,6 +83,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["xla", "flash"])
     p.add_argument("--sparse_impl", type=str, default="ref",
                    choices=["ref", "pallas"])
+    p.add_argument("--grad_accum", type=int, default=1,
+                   help="accumulate gradients over this many microbatches "
+                        "per optimizer step (batchSize must divide)")
     p.add_argument("--sp", type=int, default=0,
                    help="sequence-parallel mesh axis size (devices split "
                         "dp x sp; requires zero dropout; the token axis "
@@ -176,7 +179,8 @@ def main(argv=None):
                                  cfg=cfg, mask=mask, rng=rng, train=True,
                                  return_loss=True)
 
-    step = make_train_step(loss_fn, optimizer)
+    step = make_train_step(loss_fn, optimizer,
+                           grad_accum=args.grad_accum)
 
     global_step = 0
     for epoch in range(start_epoch, start_epoch + args.n_epochs):

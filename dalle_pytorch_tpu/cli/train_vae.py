@@ -63,6 +63,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hidden_dim", type=int, default=128)
     p.add_argument("--num_resnet_blocks", type=int, default=0)
     p.add_argument("--straight_through", action="store_true")
+    p.add_argument("--grad_accum", type=int, default=1,
+                   help="accumulate gradients over this many microbatches "
+                        "per optimizer step (batchSize must divide)")
     p.add_argument("--param_dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="dtype for NEW runs' params (bfloat16 halves HBM "
@@ -73,7 +76,8 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def make_step(cfg: V.VAEConfig, optimizer, clip: float):
+def make_step(cfg: V.VAEConfig, optimizer, clip: float,
+              grad_accum: int = 1):
     """jit step: (params, opt_state, batch{'images','temperature'}, rng) ->
     (params, opt_state, loss). Loss = smooth_l1 + mse (reference
     trainVAE.py:87); the optional weight clamp runs inside the same compiled
@@ -89,7 +93,12 @@ def make_step(cfg: V.VAEConfig, optimizer, clip: float):
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch, rng):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        if grad_accum > 1:
+            from dalle_pytorch_tpu.parallel.train import accumulate_grads
+            loss, grads = accumulate_grads(loss_fn, params, batch, rng,
+                                           grad_accum)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         if clip > 0:
@@ -128,7 +137,8 @@ def main(argv=None):
 
     params, opt_state = setup_sharded(params, optimizer, mesh,
                                       opt_state=opt_state)
-    step = make_step(cfg, optimizer, args.clip)
+    step = make_step(cfg, optimizer, args.clip,
+                     grad_accum=args.grad_accum)
 
     dataset = ImageFolderDataset(args.dataPath, args.imageSize,
                                  args.batchSize, shuffle=True,

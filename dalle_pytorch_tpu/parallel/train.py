@@ -27,22 +27,63 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 
-def make_train_step(loss_fn: Callable, optimizer) -> Callable:
+def make_train_step(loss_fn: Callable, optimizer,
+                    grad_accum: int = 1) -> Callable:
     """jit step: (params, opt_state, batch, rng) -> (params, opt_state, loss).
 
     ``loss_fn(params, batch, rng) -> scalar``. Shardings are dictated by the
     inputs (set up with ``setup_sharded``/``shard_batch``); params and opt
     state buffers are donated.
+
+    ``grad_accum > 1`` splits the batch's leading dim into that many
+    microbatches and accumulates their mean gradient in a ``lax.scan``
+    before the single optimizer update — same update as the full batch
+    (the loss is an example mean), at 1/N the activation memory. The batch
+    must be a dict; scalar entries (e.g. a traced temperature) pass
+    through unsplit, array entries' leading dim must divide.
     """
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch, rng):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        if grad_accum <= 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+        else:
+            loss, grads = accumulate_grads(loss_fn, params, batch, rng,
+                                           grad_accum)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
     return step
+
+
+def accumulate_grads(loss_fn: Callable, params, batch: dict, rng,
+                     grad_accum: int):
+    """(mean loss, mean grads) over ``grad_accum`` microbatches, scanned so
+    only one microbatch's activations are live at a time. ``batch`` is a
+    dict; entries with ndim >= 1 split on their leading dim, scalars are
+    closed over unchanged."""
+    import jax.numpy as jnp
+    if not isinstance(batch, dict):
+        raise TypeError("grad accumulation expects a dict batch")
+    split = {k: v for k, v in batch.items()
+             if getattr(v, "ndim", 0) >= 1}
+    rest = {k: v for k, v in batch.items() if k not in split}
+    micro = jax.tree.map(
+        lambda a: a.reshape(grad_accum, a.shape[0] // grad_accum,
+                            *a.shape[1:]), split)
+
+    def body(carry, mb):
+        loss_acc, grads_acc = carry
+        loss_i, grads_i = jax.value_and_grad(loss_fn)(params, {**mb, **rest},
+                                                      rng)
+        grads_acc = jax.tree.map(jnp.add, grads_acc, grads_i)
+        return (loss_acc + loss_i, grads_acc), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    (loss, grads), _ = jax.lax.scan(body, (jnp.float32(0.0), zeros), micro)
+    inv = 1.0 / grad_accum
+    return loss * inv, jax.tree.map(lambda g: g * inv, grads)
 
 
 def setup_sharded(params, optimizer, mesh: Mesh, param_specs=None,

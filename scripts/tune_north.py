@@ -21,6 +21,8 @@ def main():
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--attns", default="xla,flash")
     ap.add_argument("--batches", default="8,16,32")
+    ap.add_argument("--loss_chunks", default="0",
+                    help="comma list; 0 = dense CE head")
     args = ap.parse_args()
 
     import jax
@@ -34,8 +36,10 @@ def main():
     peak = _bf16_peak()
     results = []
     for attn in args.attns.split(","):
+      for chunk in (int(c) for c in args.loss_chunks.split(",")):
         for batch in (int(b) for b in args.batches.split(",")):
-            cfg = build_cfg(False, depth=12, attn_impl=attn)
+            cfg = build_cfg(False, depth=12, attn_impl=attn,
+                            loss_chunk=chunk)
             t0 = time.time()
             try:
                 step, params, opt_state, data, key = setup_train(
@@ -49,7 +53,7 @@ def main():
                 continue
             tps = args.steps * batch * cfg.seq_len / dt / n_dev
             mfu = tps * dalle_train_flops_per_token(cfg) / peak
-            rec = {"attn": attn, "batch": batch,
+            rec = {"attn": attn, "batch": batch, "loss_chunk": chunk,
                    "tokens_sec_chip": round(tps, 1), "mfu": round(mfu, 4),
                    "loss": round(loss, 4),
                    "setup_s": round(time.time() - t0 - dt, 1)}
