@@ -1,0 +1,151 @@
+"""CLIP training CLI — contrastive text/image pretraining for the reranker.
+
+The reference ships the CLIP model and README usage (reference
+dalle_pytorch.py:161-237, README.md:90-115) but no training script; this
+CLI closes that gap with the same data contract as train_dalle (captions
+file + `path : caption` pairs + imagefolder, SURVEY.md §5 data contract)
+so one dataset serves the whole pipeline. The trained checkpoint plugs
+into ``gen_dalle --clip_name`` for generation reranking (reference
+dalle_pytorch.py:354-356).
+
+One jit train step over a ``dp`` mesh; loss is the reference's
+one-directional (text→image) InfoNCE with a learned pre-exp temperature.
+
+Run: python -m dalle_pytorch_tpu.cli.train_clip --dataPath ./imagedata
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dalle_pytorch_tpu import checkpoint as ckpt
+from dalle_pytorch_tpu.cli.common import (add_common_args, resolve_resume,
+                                          say, setup_run)
+from dalle_pytorch_tpu.data import (CaptionDataset, load_caption_data,
+                                    load_image_batch, prefetch,
+                                    shard_for_host)
+from dalle_pytorch_tpu.models import clip as C
+from dalle_pytorch_tpu.parallel import make_train_step, shard_batch
+from dalle_pytorch_tpu.parallel.train import clip_loss_fn, setup_sharded
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="train CLIP (TPU-native DALLE-pytorch)")
+    add_common_args(p, default_batch=32)
+    p.add_argument("--dataPath", type=str, default="./imagedata")
+    p.add_argument("--imageSize", type=int, default=256)
+    p.add_argument("--captions_only", type=str,
+                   default="od-captionsonly.txt")
+    p.add_argument("--captions", type=str, default="od-captions.txt")
+    p.add_argument("--load_clip", type=str, default="",
+                   help="checkpoint path or name to continue training")
+    p.add_argument("--grad_accum", type=int, default=1)
+    # model hyperparams (reference CLIP __init__ defaults,
+    # dalle_pytorch.py:162-178)
+    p.add_argument("--dim_text", type=int, default=512)
+    p.add_argument("--dim_image", type=int, default=512)
+    p.add_argument("--dim_latent", type=int, default=512)
+    p.add_argument("--num_text_tokens", type=int, default=10000)
+    p.add_argument("--text_seq_len", type=int, default=256)
+    p.add_argument("--text_enc_depth", type=int, default=6)
+    p.add_argument("--text_heads", type=int, default=8)
+    p.add_argument("--visual_enc_depth", type=int, default=6)
+    p.add_argument("--visual_heads", type=int, default=8)
+    p.add_argument("--visual_patch_size", type=int, default=32)
+    p.add_argument("--dense", action="store_true",
+                   help="dense attention (default mirrors the reference "
+                        "Transformer default sparse_attn=True)")
+    p.add_argument("--param_dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.set_defaults(name="clip")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    mesh, metrics, profiler = setup_run(args, unit_name="pairs")
+
+    cfg = C.CLIPConfig(
+        dim_text=args.dim_text, dim_image=args.dim_image,
+        dim_latent=args.dim_latent, num_text_tokens=args.num_text_tokens,
+        text_seq_len=args.text_seq_len, text_enc_depth=args.text_enc_depth,
+        text_heads=args.text_heads, visual_enc_depth=args.visual_enc_depth,
+        visual_heads=args.visual_heads,
+        visual_image_size=args.imageSize,
+        visual_patch_size=args.visual_patch_size,
+        sparse_attn=not args.dense)
+
+    key = jax.random.PRNGKey(args.seed)
+    optimizer = optax.adam(args.lr)
+
+    start_epoch = args.start_epoch
+    opt_state = None
+    if args.load_clip:
+        path, start_epoch = resolve_resume(args.load_clip, args.models_dir,
+                                           start_epoch)
+        params, opt_state, manifest = ckpt.restore_train(path, optimizer)
+        cfg = C.CLIPConfig(**manifest["config"])
+        say(f"resumed CLIP from {path}")
+    else:
+        params = C.clip_init(key, cfg, dtype=jnp.dtype(args.param_dtype))
+
+    params, opt_state = setup_sharded(params, optimizer, mesh,
+                                      opt_state=opt_state)
+    step = make_train_step(clip_loss_fn(cfg), optimizer,
+                           grad_accum=args.grad_accum)
+
+    vocab, data = load_caption_data(args.captions_only, args.captions,
+                                    args.text_seq_len)
+    from dalle_pytorch_tpu.parallel.multihost import is_primary
+    if is_primary():
+        vocab.save(os.path.join(args.models_dir, f"{args.name}-vocab.json"))
+    data = list(shard_for_host(data))
+    say(f"{len(data)} caption/image pairs on this host")
+    dataset = CaptionDataset(data, batch_size=args.batchSize, shuffle=True,
+                             seed=args.seed)
+
+    def load_batch(item):
+        paths, toks = item
+        images = load_image_batch(paths, args.dataPath, args.imageSize)
+        return {"text": toks, "images": images,
+                "mask": np.asarray(toks) != 0}          # PAD = 0
+
+    global_step = 0
+    for epoch in range(start_epoch, start_epoch + args.n_epochs):
+        train_loss, n_batches = 0.0, 0
+        for hosted in prefetch(dataset.epoch(epoch), depth=2,
+                               transform=load_batch):
+            batch = shard_batch(mesh, hosted)
+            profiler.maybe_start(global_step)
+            params, opt_state, loss = step(
+                params, opt_state, batch,
+                jax.random.fold_in(key, global_step))
+            profiler.maybe_stop(global_step)
+            metrics.step(global_step, loss, epoch=epoch,
+                         units=args.batchSize, unit_name="pairs")
+            train_loss += float(loss)
+            n_batches += 1
+            global_step += 1
+        if n_batches == 0:
+            raise RuntimeError("empty dataset epoch")
+
+        avg = train_loss / n_batches
+        say(f"====> Epoch: {epoch} Average loss: {avg:.4f}")
+        path = ckpt.save(
+            ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
+            step=epoch, config=cfg, opt_state=opt_state, kind="clip",
+            meta={"epoch": epoch, "avg_loss": avg})
+        metrics.event(event="checkpoint", path=path, epoch=epoch,
+                      avg_loss=avg)
+    profiler.close()
+
+
+if __name__ == "__main__":
+    main()

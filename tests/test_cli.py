@@ -265,3 +265,41 @@ class TestTrainDALLESequenceParallel:
                 "--models_dir", str(workdir / "models"),
                 "--results_dir", str(workdir / "results"),
             ])
+
+
+@pytest.mark.slow
+class TestTrainCLIP:
+    def test_train_and_rerank_pipeline(self, workdir):
+        """train_clip one epoch on the synthetic pairs, then gen_dalle
+        reranks with the TRAINED checkpoint — the full reranker pipeline
+        (reference README.md:119-126) as CLIs."""
+        from dalle_pytorch_tpu.cli.train_clip import main
+        main([
+            "--dataPath", str(workdir / "imagedata"),
+            "--imageSize", str(IMG), "--batchSize", "4",
+            "--captions_only", str(workdir / "only.txt"),
+            "--captions", str(workdir / "pairs.txt"),
+            "--name", "clipcli", "--n_epochs", "1",
+            "--dim_text", "16", "--dim_image", "16", "--dim_latent", "8",
+            "--num_text_tokens", "50", "--text_seq_len", "8",
+            "--text_enc_depth", "1", "--visual_enc_depth", "1",
+            "--text_heads", "2", "--visual_heads", "2",
+            "--visual_patch_size", "8", "--dense", "--lr", "1e-3",
+            "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+            "--log_interval", "1", "--dp", "1",
+        ])
+        path, epoch = ckpt.latest(str(workdir / "models"), "clipcli")
+        assert epoch == 0
+        manifest = ckpt.load_manifest(path)
+        assert manifest["kind"] == "clip"
+
+        from dalle_pytorch_tpu.cli.gen_dalle import main as gen_main
+        gen_main([
+            "a red square",
+            "--name", "toy", "--dalle_epoch", "0",
+            "--clip_name", "clipcli", "--clip_epoch", "0",
+            "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+            "--num_images", "2",
+        ])
