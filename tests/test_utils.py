@@ -1,0 +1,82 @@
+"""Direct tests for the observability utils (SURVEY.md §5.1/5.2/5.5):
+metrics JSONL content and rate scaling, profiler trace windows, NaN/finite
+guards. The CLIs exercise these implicitly; these pin the contracts."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.utils import (MetricsLogger, StepProfiler,
+                                     enable_nan_checks)
+from dalle_pytorch_tpu.utils.debug import check_finite_tree, guard_loss
+
+
+class TestMetricsLogger:
+    def test_jsonl_records_and_rates(self, tmp_path):
+        path = tmp_path / "m.jsonl"
+        m = MetricsLogger(str(path), log_interval=2, n_devices=2)
+        for step in range(4):
+            m.step(step, loss=1.5, epoch=0, units=100, unit_name="tokens")
+        m.event(event="checkpoint", epoch=0, avg_loss=1.5)
+
+        recs = [json.loads(line) for line in path.read_text().splitlines()]
+        steps = [r for r in recs if "step" in r]
+        assert [r["step"] for r in steps] == [0, 2]
+        # single process: global rate = 2x the per-chip rate (2 chips)
+        r = steps[1]
+        assert r["tokens_per_sec"] == pytest.approx(
+            2 * r["tokens_per_sec_per_chip"], rel=1e-6)
+        assert recs[-1]["event"] == "checkpoint"
+
+    def test_no_path_no_file(self, tmp_path):
+        m = MetricsLogger(None, log_interval=1)
+        m.step(0, loss=1.0, units=1)          # must not raise
+        m.event(event="x")
+        assert list(tmp_path.iterdir()) == []
+
+
+class TestStepProfiler:
+    def test_trace_window_writes_profile(self, tmp_path):
+        prof = StepProfiler(str(tmp_path), start=1, steps=2)
+        x = jnp.ones((8, 8))
+        for i in range(4):
+            prof.maybe_start(i)
+            x = (x @ x).block_until_ready()
+            prof.maybe_stop(i)
+        prof.close()
+        found = [f for _, _, fs in os.walk(tmp_path) for f in fs]
+        assert found, "profiler wrote no trace files"
+
+    def test_disabled_is_noop(self):
+        prof = StepProfiler(None)
+        prof.maybe_start(10)
+        prof.maybe_stop(12)
+        prof.close()
+
+
+class TestDebugGuards:
+    def test_check_finite_tree_names_bad_leaves(self):
+        tree = {"ok": jnp.ones(3), "bad": jnp.array([1.0, np.nan])}
+        with pytest.raises(FloatingPointError, match="bad"):
+            check_finite_tree(tree, "params")
+        check_finite_tree({"ok": jnp.ones(3)})   # clean tree passes
+
+    def test_guard_loss(self):
+        assert guard_loss(jnp.float32(1.25), 3) == 1.25
+        with pytest.raises(FloatingPointError, match="step 7"):
+            guard_loss(jnp.float32(np.inf), 7)
+
+    def test_nan_check_toggle_traps_and_restores(self):
+        enable_nan_checks(True)
+        try:
+            with pytest.raises(FloatingPointError):
+                jax.jit(lambda x: x / 0.0)(jnp.float32(1.0)).block_until_ready()
+        finally:
+            enable_nan_checks(False)
+        # trap off again: division produces inf silently
+        assert not np.isfinite(float(jax.jit(lambda x: x / 0.0)(
+            jnp.float32(1.0))))
