@@ -31,10 +31,14 @@ from dalle_pytorch_tpu.compat import (import_clip, import_dalle, import_vae,
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         description="import a reference DALLE-pytorch .pth checkpoint")
-    p.add_argument("kind", choices=["vae", "dalle", "clip"])
-    p.add_argument("pth", help="path to the torch state_dict file")
+    p.add_argument("kind", choices=["vae", "dalle", "clip",
+                                    "export-vae", "export-dalle",
+                                    "export-clip"])
+    p.add_argument("pth", help="torch state_dict path (the OUTPUT for "
+                               "export-* kinds)")
     p.add_argument("--out", required=True,
-                   help="output checkpoint directory (e.g. models/vae-0)")
+                   help="checkpoint directory (output for imports, INPUT "
+                        "for export-* kinds)")
     p.add_argument("--image_size", type=int, default=256,
                    help="VAE training image size (not stored in weights)")
     p.add_argument("--heads", type=int, default=8,
@@ -48,6 +52,32 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+
+    if args.kind.startswith("export-"):
+        # checkpoint dir -> reference-layout .pth (compat.torch_export)
+        from dalle_pytorch_tpu.compat import (export_clip, export_dalle,
+                                              export_vae,
+                                              save_torch_state_dict)
+        params, manifest = ckpt.restore_params(args.out)
+        kind = args.kind.removeprefix("export-")
+        if manifest.get("kind") not in (kind, "model"):
+            raise SystemExit(f"checkpoint {args.out} is kind="
+                             f"{manifest.get('kind')!r}, expected {kind!r}")
+        if kind == "vae":
+            sd = export_vae(params)
+        elif kind == "clip":
+            sd = export_clip(params)
+        else:
+            vae_path = manifest.get("meta", {}).get("vae_checkpoint")
+            vae_params = None
+            if vae_path:
+                vae_params, _ = ckpt.restore_params(vae_path)
+            sd = export_dalle(params, vae_params)
+        save_torch_state_dict(sd, args.pth)
+        print(f"wrote reference-layout state dict {args.pth} "
+              f"({len(sd)} tensors)")
+        return
+
     sd = load_torch_state_dict(args.pth)
 
     if args.kind == "vae":

@@ -1,11 +1,18 @@
 """Interop with the reference PyTorch implementation: ``.pth`` checkpoint
 import (reference trainVAE.py:119 / trainDALLE.py:212 save format) into
-this package's pytrees. Torch (CPU) is only imported when used."""
+this package's pytrees, and export back out. Torch (CPU) is only imported
+when used."""
 
+from dalle_pytorch_tpu.compat.torch_export import (export_clip, export_dalle,
+                                                   export_transformer,
+                                                   export_vae,
+                                                   save_torch_state_dict)
 from dalle_pytorch_tpu.compat.torch_import import (import_clip, import_dalle,
                                                    import_transformer,
                                                    import_vae,
                                                    load_torch_state_dict)
 
 __all__ = ["import_clip", "import_dalle", "import_transformer",
-           "import_vae", "load_torch_state_dict"]
+           "import_vae", "load_torch_state_dict",
+           "export_clip", "export_dalle", "export_transformer",
+           "export_vae", "save_torch_state_dict"]

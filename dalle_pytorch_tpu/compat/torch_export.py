@@ -1,0 +1,142 @@
+"""Export this framework's pytrees to reference-layout torch state dicts —
+the inverse of ``compat.torch_import``, so models trained here drop back
+into the reference PyTorch ecosystem (same key names and tensor layouts the
+reference's ``load_state_dict`` resume path reads, reference
+trainVAE.py:52-54, trainDALLE.py:64-67).
+
+Layout transforms mirror torch conventions exactly (see torch_import's
+module docstring); ``import_*(export_*(params))`` round-trips bit-exactly,
+which the tests pin.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import numpy as np
+
+
+def _t(x) -> np.ndarray:
+    return np.asarray(x, np.float32)
+
+
+def _linear(out: Dict[str, np.ndarray], prefix: str, p: dict) -> None:
+    out[prefix + ".weight"] = _t(p["w"]).T
+    if "b" in p:
+        out[prefix + ".bias"] = _t(p["b"])
+
+
+def _layernorm(out, prefix: str, p: dict) -> None:
+    out[prefix + ".weight"] = _t(p["g"])
+    out[prefix + ".bias"] = _t(p["b"])
+
+
+def _conv(out, prefix: str, p: dict) -> None:
+    out[prefix + ".weight"] = _t(p["w"]).transpose(3, 2, 0, 1)   # HWIO->OIHW
+    out[prefix + ".bias"] = _t(p["b"])
+
+
+def _conv_transpose(out, prefix: str, p: dict) -> None:
+    out[prefix + ".weight"] = _t(p["w"]).transpose(2, 3, 0, 1)   # HWIO->IOHW
+    out[prefix + ".bias"] = _t(p["b"])
+
+
+def _resblock(out, prefix: str, p: dict) -> None:
+    _conv(out, prefix + "net.0", p["c1"])
+    _conv(out, prefix + "net.2", p["c2"])
+    _conv(out, prefix + "net.4", p["c3"])
+
+
+def export_vae(params: dict) -> Dict[str, np.ndarray]:
+    """VAE pytree -> reference DiscreteVAE state dict (Sequential indices
+    per reference dalle_pytorch.py:88-119)."""
+    out: Dict[str, np.ndarray] = {"codebook.weight": _t(
+        params["codebook"]["w"])}
+    L = len(params["enc_convs"])
+    R = len(params["enc_res"])
+    for i, p in enumerate(params["enc_convs"]):
+        _conv(out, f"encoder.{i}.0", p)
+    for r, p in enumerate(params["enc_res"]):
+        _resblock(out, f"encoder.{L + r}.", p)
+    _conv(out, f"encoder.{L + R}", params["enc_out"])
+
+    off = 1 if "dec_stem" in params else 0
+    if off:
+        _conv(out, "decoder.0", params["dec_stem"])
+    for r, p in enumerate(params["dec_res"]):
+        _resblock(out, f"decoder.{off + r}.", p)
+    for i, p in enumerate(params["dec_convs"]):
+        _conv_transpose(out, f"decoder.{off + R + i}.0", p)
+    _conv(out, f"decoder.{off + R + L}", params["dec_out"])
+    return out
+
+
+def export_transformer(stacked: dict) -> Dict[str, np.ndarray]:
+    """Depth-stacked transformer params -> per-layer reference keys
+    (``layers.layers.{i}.{0,1}...``, the SequentialSequence naming)."""
+    out: Dict[str, np.ndarray] = {}
+    depth = jax.tree.leaves(stacked)[0].shape[0]
+    for i in range(depth):
+        lp = jax.tree.map(lambda a: a[i], stacked)
+        a = f"layers.layers.{i}.0."
+        _layernorm(out, a + "norm", lp["attn"]["ln"])
+        _linear(out, a + "fn.to_qkv", lp["attn"]["qkv"])
+        _linear(out, a + "fn.to_out.0", lp["attn"]["out"])
+        f = f"layers.layers.{i}.1."
+        _layernorm(out, f + "norm", lp["ff"]["ln"])
+        _linear(out, f + "fn.net.0", lp["ff"]["w1"])
+        _linear(out, f + "fn.net.3", lp["ff"]["w2"])
+    return out
+
+
+def export_dalle(params: dict, vae_params: dict = None,
+                 image_size: int = 256) -> Dict[str, np.ndarray]:
+    """DALLE pytree -> reference state dict. ``vae_params`` fills the
+    embedded ``vae.*`` subtree; the tied ``image_emb``/codebook uses
+    DALLE's live table (it owns the trained copy, models.dalle docstring,
+    reference dalle_pytorch.py:283)."""
+    out: Dict[str, np.ndarray] = {}
+    out["text_emb.weight"] = _t(params["text_emb"]["w"])
+    out["image_emb.weight"] = _t(params["image_emb"]["w"])
+    out["text_pos_emb.weight"] = _t(params["text_pos_emb"]["w"])
+    rows = _t(params["image_pos_emb"]["rows"])
+    cols = _t(params["image_pos_emb"]["cols"])
+    dim = rows.shape[-1]
+    out["image_pos_emb.weights.0"] = rows.reshape(1, rows.shape[0], 1, dim)
+    out["image_pos_emb.weights.1"] = cols.reshape(1, 1, cols.shape[0], dim)
+    for k, v in export_transformer(params["transformer"]).items():
+        out[f"transformer.{k}"] = v
+    _layernorm(out, "to_logits.0", params["to_logits"]["ln"])
+    _linear(out, "to_logits.1", params["to_logits"]["proj"])
+    if vae_params is not None:
+        vae_sd = export_vae(vae_params)
+        # the reference's tie makes vae.codebook the same tensor as
+        # image_emb; keep the export consistent with DALLE's trained copy
+        vae_sd["codebook.weight"] = out["image_emb.weight"]
+        for k, v in vae_sd.items():
+            out[f"vae.{k}"] = v
+    return out
+
+
+def export_clip(params: dict) -> Dict[str, np.ndarray]:
+    """CLIP pytree -> reference state dict (dalle_pytorch.py:180-195)."""
+    out: Dict[str, np.ndarray] = {}
+    out["text_emb.weight"] = _t(params["text_emb"]["w"])
+    out["text_pos_emb.weight"] = _t(params["text_pos_emb"]["w"])
+    for k, v in export_transformer(params["text_transformer"]).items():
+        out[f"text_transformer.{k}"] = v
+    _linear(out, "to_text_latent", params["to_text_latent"])
+    _linear(out, "to_visual_embedding", params["to_visual_emb"])
+    out["visual_pos_emb.weight"] = _t(params["visual_pos_emb"]["w"])
+    for k, v in export_transformer(params["visual_transformer"]).items():
+        out[f"visual_transformer.{k}"] = v
+    _linear(out, "to_visual_latent", params["to_visual_latent"])
+    out["temperature"] = _t(params["temperature"]).reshape(())
+    return out
+
+
+def save_torch_state_dict(sd: Dict[str, np.ndarray], path: str) -> None:
+    """Write as a torch-loadable ``.pth`` (torch CPU)."""
+    import torch
+    torch.save({k: torch.tensor(v) for k, v in sd.items()}, path)
