@@ -17,6 +17,12 @@ directly.
 For DALLE the embedded VAE (reference ties it into the DALLE state dict,
 dalle_pytorch.py:283) can be written as its own checkpoint too, so the
 whole pipeline is reconstructed from one file.
+
+The export-* kinds run the other direction — a framework checkpoint
+becomes a reference-layout ``.pth`` torch's ``load_state_dict`` accepts:
+
+    python -m dalle_pytorch_tpu.cli.import_torch export-vae out.pth \
+        --out ./models/vae-99
 """
 
 from __future__ import annotations
