@@ -61,7 +61,9 @@ def _kernel(*refs, scale, causal, block_q, block_k, seq_len, has_mask, block,
     else:
         q_ref, k_ref, v_ref, o_ref, m_ref, l_ref = refs
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
+    # input-dtype MXU operands + f32 accumulation (bf16 runs the systolic
+    # array at full rate); the scale applies to the f32 scores
+    q = q_ref[0]
     rows = iq * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, 1), 0)                       # (BQ, 1)
 
@@ -85,10 +87,11 @@ def _kernel(*refs, scale, causal, block_q, block_k, seq_len, has_mask, block,
     def body(ik, carry):
         def update(carry):
             m, l, acc = carry
-            kb = k_ref[0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
-            vb = v_ref[0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
+            kb = k_ref[0, pl.ds(ik * block_k, block_k), :]
+            vb = v_ref[0, pl.ds(ik * block_k, block_k), :]
             s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
+                                    preferred_element_type=jnp.float32) \
+                * scale
             cols = ik * block_k + lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)               # (1, BK)
             if has_mask:
@@ -106,7 +109,7 @@ def _kernel(*refs, scale, causal, block_q, block_k, seq_len, has_mask, block,
             alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
             l = l * alpha + p.sum(axis=-1, keepdims=True)
             acc = acc * alpha + jax.lax.dot_general(
-                p, vb, (((1,), (0,)), ((), ())),
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             return m_new, l, acc
 

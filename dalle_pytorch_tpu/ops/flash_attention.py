@@ -64,7 +64,10 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
     else:
         q_ref, k_ref, v_ref, o_ref, m_ref, l_ref = refs
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale              # (BQ, d)
+    # MXU operands stay in the INPUT dtype (bf16 in training — full-rate
+    # systolic passes) with f32 ACCUMULATION via preferred_element_type;
+    # the scale applies to the f32 scores. Mirrors the backward's policy.
+    q = q_ref[0]                                           # (BQ, d)
     rows = iq * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     cols_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -77,10 +80,10 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
 
     def body(ik, carry):
         m, l, acc = carry
-        kb = k_ref[0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(ik * block_k, block_k), :].astype(jnp.float32)
+        kb = k_ref[0, pl.ds(ik * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(ik * block_k, block_k), :]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if has_mask:
             km = mk_ref[0, :1, pl.ds(ik * block_k, block_k)] != 0  # (1, BK)
             pad_ok = km & qm
@@ -96,7 +99,7 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         alpha = jnp.exp(m - m_new)
         l = l * alpha + p.sum(axis=-1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l, acc
 
