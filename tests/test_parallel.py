@@ -463,3 +463,28 @@ class TestGradAccumulation:
         np.testing.assert_allclose(float(l2), float(l1), rtol=1e-6)
         np.testing.assert_allclose(np.asarray(p2["w"]), np.asarray(p1["w"]),
                                    atol=1e-6)
+
+    def test_sp_with_chunked_ce_matches_dense(self):
+        """loss_chunk composes with sequence parallelism (the chunked head
+        runs under GSPMD on the sp-sharded activations)."""
+        import dataclasses
+        from dalle_pytorch_tpu.models import dalle as D
+        from dalle_pytorch_tpu.models import vae as V
+        from dalle_pytorch_tpu.parallel import (make_mesh, shard_batch,
+                                                sp_dalle_loss_fn)
+        from dalle_pytorch_tpu.parallel.train import dalle_loss_fn
+        vcfg = V.VAEConfig(image_size=16, num_tokens=12, codebook_dim=16,
+                           num_layers=2, hidden_dim=8)
+        cfg = D.DALLEConfig(dim=16, depth=2, vae=vcfg, num_text_tokens=20,
+                            text_seq_len=8, heads=4, dim_head=4,
+                            loss_chunk=5)
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        params = D.dalle_init(jax.random.PRNGKey(0), cfg)
+        key = jax.random.PRNGKey(1)
+        batch = {"text": jax.random.randint(key, (4, 8), 0, 20),
+                 "image": jax.random.randint(key, (4, 16), 0, 12)}
+        dense = dalle_loss_fn(dataclasses.replace(cfg, loss_chunk=0))(
+            params, batch, key)
+        sp = sp_dalle_loss_fn(cfg, mesh, batch_axis="dp")(
+            params, shard_batch(mesh, batch, axis="dp"), key)
+        np.testing.assert_allclose(float(sp), float(dense), rtol=1e-5)
