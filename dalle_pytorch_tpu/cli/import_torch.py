@@ -106,7 +106,14 @@ def main(argv=None):
         if vae_params is None:
             raise SystemExit("this .pth has no embedded vae.* weights; "
                              "import the VAE separately")
-        inner = cfg_kw.pop("dim_head") * 8     # stored assuming 8 heads
+        cfg_kw.pop("dim_head")                 # heads-assuming heuristic
+        # recover the true inner dim from the imported qkv weights
+        # (dim, 3*inner) — heads can't be inferred, so --heads must divide
+        inner = params["transformer"]["attn"]["qkv"]["w"].shape[-1] // 3
+        if inner % args.heads:
+            raise SystemExit(
+                f"--heads {args.heads} does not divide the checkpoint's "
+                f"attention inner dim {inner}")
         cfg = DALLEConfig(vae=VAEConfig(**vae_cfg_kw), heads=args.heads,
                           dim_head=inner // args.heads, **cfg_kw)
         path = ckpt.save(args.out, params, step=args.epoch, config=cfg,

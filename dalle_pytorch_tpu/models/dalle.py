@@ -248,7 +248,16 @@ def dalle_apply(params: dict, text: Array, image=None, *, cfg: DALLEConfig,
 
     if image_ids is None:
         raise ValueError("when training, image must be supplied")
+    return ce_from_hidden(params, h, text, image_ids, cfg=cfg)
 
+
+def ce_from_hidden(params: dict, h: Array, text: Array, image_ids: Array, *,
+                   cfg: DALLEConfig) -> Array:
+    """The training-loss tail shared by every execution path (single-device
+    ``dalle_apply`` and the sequence-parallel loss in parallel.sequence):
+    labels = [text, image+offset] shifted left with EOS appended, masked
+    logits, mean CE (reference dalle_pytorch.py:391-406). Honors
+    ``cfg.loss_chunk`` (streamed head)."""
     labels = jnp.concatenate(
         [text, image_ids + cfg.num_text_tokens,
          jnp.full((text.shape[0], 1), cfg.eos_token_id, text.dtype)], axis=1)
@@ -257,7 +266,7 @@ def dalle_apply(params: dict, text: Array, image=None, *, cfg: DALLEConfig,
     if cfg.loss_chunk > 0:
         return _chunked_ce(params, h, targets, cfg)
     logits = to_logits(params, h)
-    forbidden = logits_mask(cfg)[:seq_len]
+    forbidden = logits_mask(cfg)[:h.shape[1]]
     logits = jnp.where(forbidden[None], core.neg_inf(logits.dtype), logits)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

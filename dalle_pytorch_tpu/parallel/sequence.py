@@ -135,20 +135,7 @@ def sp_dalle_loss_fn(cfg, mesh: Mesh, *, sp_axis: str = "sp",
                                  cfg=cfg.transformer, mesh=mesh,
                                  sp_axis=sp_axis, batch_axis=batch_axis,
                                  impl=impl, mask=mask)
-
-        labels = jnp.concatenate(
-            [text, image_ids + cfg.num_text_tokens,
-             jnp.full((text.shape[0], 1), cfg.eos_token_id, text.dtype)],
-            axis=1)
-        targets = labels[:, 1:]
-        if cfg.loss_chunk > 0:
-            return D._chunked_ce(params, h, targets, cfg)
-        logits = D.to_logits(params, h)
-        forbidden = D.logits_mask(cfg)[:h.shape[1]]
-        logits = jnp.where(forbidden[None], core.neg_inf(logits.dtype),
-                           logits)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return jnp.mean(nll)
+        # same loss tail as dalle_apply — one definition of the contract
+        return D.ce_from_hidden(params, h, text, image_ids, cfg=cfg)
 
     return loss

@@ -37,10 +37,14 @@ def make_train_step(loss_fn: Callable, optimizer,
 
     ``grad_accum > 1`` splits the batch's leading dim into that many
     microbatches and accumulates their mean gradient in a ``lax.scan``
-    before the single optimizer update — same update as the full batch
-    (the loss is an example mean), at 1/N the activation memory. The batch
-    must be a dict; scalar entries (e.g. a traced temperature) pass
-    through unsplit, array entries' leading dim must divide.
+    before the single optimizer update — the same update as the full batch
+    when the loss is deterministic (it is an example mean); with RNG in the
+    loss (dropout, Gumbel noise) each microbatch gets an independent
+    ``fold_in``-derived key, so noise stays decorrelated across the
+    accumulated batch (not bitwise the full-batch draw). Activation memory
+    is 1/N. The batch must be a dict; scalar entries (e.g. a traced
+    temperature) pass through unsplit, array entries' leading dim must
+    divide.
     """
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -62,7 +66,9 @@ def accumulate_grads(loss_fn: Callable, params, batch: dict, rng,
     """(mean loss, mean grads) over ``grad_accum`` microbatches, scanned so
     only one microbatch's activations are live at a time. ``batch`` is a
     dict; entries with ndim >= 1 split on their leading dim, scalars are
-    closed over unchanged."""
+    closed over unchanged. Each microbatch's loss sees a distinct
+    ``fold_in(rng, i)`` key — identical keys would correlate dropout/noise
+    across the whole accumulated batch."""
     import jax.numpy as jnp
     if not isinstance(batch, dict):
         raise TypeError("grad accumulation expects a dict batch")
@@ -73,15 +79,17 @@ def accumulate_grads(loss_fn: Callable, params, batch: dict, rng,
         lambda a: a.reshape(grad_accum, a.shape[0] // grad_accum,
                             *a.shape[1:]), split)
 
-    def body(carry, mb):
+    def body(carry, xs):
+        i, mb = xs
         loss_acc, grads_acc = carry
-        loss_i, grads_i = jax.value_and_grad(loss_fn)(params, {**mb, **rest},
-                                                      rng)
+        loss_i, grads_i = jax.value_and_grad(loss_fn)(
+            params, {**mb, **rest}, jax.random.fold_in(rng, i))
         grads_acc = jax.tree.map(jnp.add, grads_acc, grads_i)
         return (loss_acc + loss_i, grads_acc), None
 
     zeros = jax.tree.map(jnp.zeros_like, params)
-    (loss, grads), _ = jax.lax.scan(body, (jnp.float32(0.0), zeros), micro)
+    (loss, grads), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), zeros), (jnp.arange(grad_accum), micro))
     inv = 1.0 / grad_accum
     return loss * inv, jax.tree.map(lambda g: g * inv, grads)
 
