@@ -86,6 +86,24 @@ def add_common_args(parser: argparse.ArgumentParser,
                         help="JSONL metrics file path")
 
 
+def load_caption_dataset(args):
+    """(vocab, host-sharded CaptionDataset) from the --captions* flags —
+    the reference's caption data contract (SURVEY.md §5), shared by
+    train_dalle and train_clip. Saves the vocab next to the checkpoints
+    (process 0 only on shared filesystems)."""
+    from dalle_pytorch_tpu.data import (CaptionDataset, load_caption_data,
+                                        shard_for_host)
+    from dalle_pytorch_tpu.parallel.multihost import is_primary
+    vocab, data = load_caption_data(args.captions_only, args.captions,
+                                    args.text_seq_len)
+    if is_primary():
+        vocab.save(os.path.join(args.models_dir, f"{args.name}-vocab.json"))
+    data = list(shard_for_host(data))
+    say(f"{len(data)} caption/image pairs on this host")
+    return vocab, CaptionDataset(data, batch_size=args.batchSize,
+                                 shuffle=True, seed=args.seed)
+
+
 def setup_run(args, unit_name: str = "tokens"):
     """-> (mesh, MetricsLogger, StepProfiler). Applies NaN toggles/seeding.
 

@@ -25,11 +25,10 @@ import numpy as np
 import optax
 
 from dalle_pytorch_tpu import checkpoint as ckpt
-from dalle_pytorch_tpu.cli.common import (add_common_args, resolve_resume,
-                                          say, setup_run)
-from dalle_pytorch_tpu.data import (CaptionDataset, load_caption_data,
-                                    load_image_batch, prefetch,
-                                    shard_for_host)
+from dalle_pytorch_tpu.cli.common import (add_common_args,
+                                          load_caption_dataset,
+                                          resolve_resume, say, setup_run)
+from dalle_pytorch_tpu.data import load_image_batch, prefetch
 from dalle_pytorch_tpu.models import clip as C
 from dalle_pytorch_tpu.parallel import make_train_step, shard_batch
 from dalle_pytorch_tpu.parallel.train import clip_loss_fn, setup_sharded
@@ -101,15 +100,7 @@ def main(argv=None):
     step = make_train_step(clip_loss_fn(cfg), optimizer,
                            grad_accum=args.grad_accum)
 
-    vocab, data = load_caption_data(args.captions_only, args.captions,
-                                    args.text_seq_len)
-    from dalle_pytorch_tpu.parallel.multihost import is_primary
-    if is_primary():
-        vocab.save(os.path.join(args.models_dir, f"{args.name}-vocab.json"))
-    data = list(shard_for_host(data))
-    say(f"{len(data)} caption/image pairs on this host")
-    dataset = CaptionDataset(data, batch_size=args.batchSize, shuffle=True,
-                             seed=args.seed)
+    vocab, dataset = load_caption_dataset(args)
 
     def load_batch(item):
         paths, toks = item

@@ -37,11 +37,11 @@ import numpy as np
 import optax
 
 from dalle_pytorch_tpu import checkpoint as ckpt
-from dalle_pytorch_tpu.cli.common import (add_common_args, resolve_resume,
-                                          say, setup_run)
-from dalle_pytorch_tpu.data import (CaptionDataset, load_caption_data,
-                                    load_image_batch, prefetch,
-                                    save_image_grid, shard_for_host)
+from dalle_pytorch_tpu.cli.common import (add_common_args,
+                                          load_caption_dataset,
+                                          resolve_resume, say, setup_run)
+from dalle_pytorch_tpu.data import (load_image_batch, prefetch,
+                                    save_image_grid)
 from dalle_pytorch_tpu.models import dalle as D
 from dalle_pytorch_tpu.models import vae as V
 from dalle_pytorch_tpu.parallel import shard_batch
@@ -147,15 +147,7 @@ def main(argv=None):
                                       opt_state=opt_state)
 
     # -- data --------------------------------------------------------------
-    vocab, data = load_caption_data(args.captions_only, args.captions,
-                                    args.text_seq_len)
-    from dalle_pytorch_tpu.parallel.multihost import is_primary
-    if is_primary():                  # one writer on shared filesystems
-        vocab.save(os.path.join(args.models_dir, f"{args.name}-vocab.json"))
-    data = list(shard_for_host(data))
-    say(f"{len(data)} caption/image pairs on this host")
-    dataset = CaptionDataset(data, batch_size=args.batchSize, shuffle=True,
-                             seed=args.seed)
+    vocab, dataset = load_caption_dataset(args)
 
     tokenize = jax.jit(functools.partial(V.get_codebook_indices, vae_params))
 

@@ -138,21 +138,13 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
     pass-through).
     """
     size = mesh.shape[axis]
-    spec = P(batch_axis, None, axis, None)
-    m_spec = P(batch_axis, axis)
 
-    if mask is None:
-        def local(q, k, v):
-            return ring_attention_local(q, k, v, axis=axis, size=size,
-                                        causal=causal, scale=scale)
-        return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
-
-    def local(q, k, v, mask):
+    def local(q, k, v, *m):
         return ring_attention_local(q, k, v, axis=axis, size=size,
-                                    causal=causal, scale=scale, mask=mask)
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, m_spec),
-                     out_specs=spec)(q, k, v, mask)
+                                    causal=causal, scale=scale,
+                                    mask=m[0] if m else None)
+
+    return _sharded_attn(local, mesh, axis, batch_axis, q, k, v, mask)
 
 
 def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
@@ -170,21 +162,25 @@ def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
         raise ValueError(f"heads {q.shape[1]} not divisible by mesh axis "
                          f"{axis} ({size})")
 
-    spec = P(batch_axis, None, axis, None)
-    m_spec = P(batch_axis, axis)
-
-    if mask is None:
-        def local(q, k, v):
-            return ulysses_attention_local(q, k, v, axis=axis,
-                                           causal=causal, scale=scale)
-        return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
-
-    def local(q, k, v, mask):
+    def local(q, k, v, *m):
         return ulysses_attention_local(q, k, v, axis=axis, causal=causal,
-                                       scale=scale, mask=mask)
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, m_spec),
-                     out_specs=spec)(q, k, v, mask)
+                                       scale=scale,
+                                       mask=m[0] if m else None)
+
+    return _sharded_attn(local, mesh, axis, batch_axis, q, k, v, mask)
+
+
+def _sharded_attn(local, mesh: Mesh, axis: str, batch_axis, q, k, v, mask):
+    """Shared shard_map plumbing for the standalone wrappers: q/k/v
+    sequence-sharded over ``axis``, the optional (b, n) mask alongside."""
+    spec = P(batch_axis, None, axis, None)
+    in_specs = [spec, spec, spec]
+    args = [q, k, v]
+    if mask is not None:
+        in_specs.append(P(batch_axis, axis))
+        args.append(mask)
+    return shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                     out_specs=spec)(*args)
 
 
 def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True,
