@@ -218,3 +218,58 @@ def test_flash_gradients_ragged_no_mask_non_causal(key):
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.array(a), np.array(b), atol=5e-4)
+
+
+class TestBf16Operands:
+    """The kernels keep MXU operands in the input dtype (bf16 at full
+    systolic rate) with f32 accumulation; parity vs the f32 oracle must
+    stay at bf16 rounding scale (~0.5%), not blow up."""
+
+    def _qkv(self, b=2, h=2, n=256, d=64):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (b, h, n, d), jnp.bfloat16)
+                   for kk in ks)
+        mask = jnp.ones((b, n), bool).at[1, 200:].set(False)
+        return q, k, v, mask, d ** -0.5
+
+    def test_flash_bf16_fwd_and_grad(self):
+        from dalle_pytorch_tpu.ops.attention import dense_attention_weights
+        from dalle_pytorch_tpu.ops.flash_attention import flash_attention
+        q, k, v, mask, scale = self._qkv()
+        o = flash_attention(q, k, v, scale=scale, causal=True, mask=mask)
+        w = dense_attention_weights(q.astype(jnp.float32),
+                                    k.astype(jnp.float32), scale, mask, True)
+        ref = jnp.einsum("bhij,bhjd->bhid", w, v.astype(jnp.float32))
+        rel = float(jnp.max(jnp.abs(o.astype(jnp.float32) - ref))
+                    / jnp.max(jnp.abs(ref)))
+        assert rel < 2e-2, rel
+
+        def loss(fn):
+            return lambda *a: (fn(*a).astype(jnp.float32) ** 2).sum()
+
+        g = jax.grad(loss(lambda q, k, v: flash_attention(
+            q, k, v, scale=scale, causal=True, mask=mask)),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss(lambda q, k, v: jnp.einsum(
+            "bhij,bhjd->bhid",
+            dense_attention_weights(q, k, scale, mask, True), v)),
+            argnums=(0, 1, 2))(q.astype(jnp.float32), k.astype(jnp.float32),
+                               v.astype(jnp.float32))
+        grel = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) - b_))
+                         / (float(jnp.max(jnp.abs(b_))) + 1e-9))
+                   for a, b_ in zip(g, gr))
+        assert grel < 3e-2, grel
+
+    def test_block_sparse_bf16_fwd(self):
+        from dalle_pytorch_tpu.ops.block_sparse import block_sparse_attention
+        from dalle_pytorch_tpu.ops.sparse import sparse_attention_ref
+        q, k, v, mask, scale = self._qkv()
+        o = block_sparse_attention(q, k, v, scale=scale, causal=True,
+                                   mask=mask)
+        r = sparse_attention_ref(q.astype(jnp.float32),
+                                 k.astype(jnp.float32),
+                                 v.astype(jnp.float32), scale=scale,
+                                 causal=True, mask=mask)
+        rel = float(jnp.max(jnp.abs(o.astype(jnp.float32) - r))
+                    / jnp.max(jnp.abs(r)))
+        assert rel < 2e-2, rel
