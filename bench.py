@@ -578,24 +578,44 @@ def main():
         # process, so an inherited JAX_PLATFORMS=axon would fail init
         os.environ["JAX_PLATFORMS"] = "cpu"
 
-    try:
-        import jax
-        _enable_compile_cache()
-        jax.devices()                      # force backend init NOW
-    except Exception as e:
+    # Backend init under a DEADLINE: on this platform a wedged tunnel makes
+    # the chip claim PEND for ~25 min before erroring UNAVAILABLE (observed
+    # 2026-07-30, hours-long outage) — an unbounded jax.devices() here
+    # would hang the driver's whole bench invocation. A healthy claim takes
+    # ~30-60 s; 600 s is generous. On timeout/error: re-exec for a fresh
+    # claim up to --retries, then a diagnostic JSON line, exit 1.
+    import threading
+    init: dict = {}
+
+    def _init_backend():
+        try:
+            import jax
+            _enable_compile_cache()
+            init["devices"] = jax.devices()
+        except Exception as e:
+            init["error"] = e
+
+    t = threading.Thread(target=_init_backend, daemon=True)
+    t.start()
+    t.join(float(os.environ.get("BENCH_INIT_DEADLINE_S", "600")))
+    err = ("backend init exceeded deadline (tunnel wedged?)"
+           if t.is_alive() else init.get("error"))
+    if err is not None:
         attempt = int(os.environ.get(RETRY_ENV, "0"))
-        if attempt < args.retries:
-            # a failed axon claim poisons this process — re-exec for a
-            # fresh interpreter (and a fresh TPU claim)
+        _progress(f"backend init failed (attempt {attempt + 1}): {err}")
+        if attempt < args.retries and not t.is_alive():
+            # a failed claim poisons this process — re-exec for a fresh
+            # interpreter + claim (pointless while still pending, so only
+            # when the init actually ERRORED rather than timed out)
             time.sleep(10 * (attempt + 1))
             env = dict(os.environ)
             env[RETRY_ENV] = str(attempt + 1)
-            os.execve(sys.executable,
-                      [sys.executable] + sys.argv, env)
-        _emit({"metric": "bench failed: TPU backend init", "value": None,
-               "unit": None, "vs_baseline": None,
-               "error": f"{type(e).__name__}: {e}",
-               "attempts": attempt + 1}, code=1)
+            os.execve(sys.executable, [sys.executable] + sys.argv, env)
+        print(json.dumps(
+            {"metric": "bench failed: TPU backend init", "value": None,
+             "unit": None, "vs_baseline": None, "error": str(err),
+             "attempts": attempt + 1}), flush=True)
+        os._exit(1)                        # daemon thread may still pend
 
     try:
         _emit({"all": bench_all, "north": bench_north, "vae": bench_vae,
