@@ -117,3 +117,20 @@ class TestDataLayerIntegration:
         Image.fromarray(arr).save(p)
         out = load_image_batch([str(p)], image_size=8)
         np.testing.assert_allclose(out[0], load_image(str(p), 8), atol=1e-6)
+
+
+class TestThreadSafety:
+    def test_concurrent_batch_loads_are_stable(self, images):
+        """The prefetcher decodes on worker threads while other threads may
+        decode too; the C ABI must be reentrant (it keeps no global state
+        besides the dlopen handle)."""
+        import concurrent.futures as cf
+        paths = [images["rgb"], images["photo"], images["gray"]] * 3
+        ref = native.load_image_batch_native(paths, 16)
+
+        def work(_):
+            return native.load_image_batch_native(paths, 16)
+
+        with cf.ThreadPoolExecutor(max_workers=4) as ex:
+            for out in ex.map(work, range(8)):
+                np.testing.assert_array_equal(out, ref)
