@@ -125,9 +125,20 @@ def setup_run(args, unit_name: str = "tokens"):
             f"--dp {args.dp} is not supported in multi-host mode: the mesh "
             f"must span all {len(jax.devices())} global devices")
     sp = getattr(args, "sp", 0) or 1
+    pp = getattr(args, "pp", 0) or 1
+    if sp > 1 and pp > 1:
+        raise SystemExit("--sp and --pp cannot be combined (pick one "
+                         "model-parallel axis per run)")
     if sp > 1 and n % sp:
         raise SystemExit(f"--sp {sp} must divide the device count ({n})")
-    axes = {"dp": n // sp, "sp": sp} if sp > 1 else {"dp": n}
+    if pp > 1 and n % pp:
+        raise SystemExit(f"--pp {pp} must divide the device count ({n})")
+    if sp > 1:
+        axes = {"dp": n // sp, "sp": sp}
+    elif pp > 1:
+        axes = {"dp": n // pp, "pp": pp}
+    else:
+        axes = {"dp": n}
     mesh = make_mesh(axes, jax.devices()[:n])
     # the train loops feed MetricsLogger host-LOCAL units, so the per-chip
     # denominator is this host's share of the mesh

@@ -101,21 +101,16 @@ def main(argv=None):
     if args.kind == "dalle":
         from dalle_pytorch_tpu.models.dalle import DALLEConfig
         from dalle_pytorch_tpu.models.vae import VAEConfig
-        params, vae_params, cfg_kw, vae_cfg_kw = import_dalle(
-            sd, image_size=args.image_size)
+        try:
+            params, vae_params, cfg_kw, vae_cfg_kw = import_dalle(
+                sd, image_size=args.image_size, heads=args.heads)
+        except ValueError as e:           # --heads doesn't divide inner dim
+            raise SystemExit(str(e))
         if vae_params is None:
             raise SystemExit("this .pth has no embedded vae.* weights; "
                              "import the VAE separately")
-        cfg_kw.pop("dim_head")                 # heads-assuming heuristic
-        # recover the true inner dim from the imported qkv weights
-        # (dim, 3*inner) — heads can't be inferred, so --heads must divide
-        inner = params["transformer"]["attn"]["qkv"]["w"].shape[-1] // 3
-        if inner % args.heads:
-            raise SystemExit(
-                f"--heads {args.heads} does not divide the checkpoint's "
-                f"attention inner dim {inner}")
         cfg = DALLEConfig(vae=VAEConfig(**vae_cfg_kw), heads=args.heads,
-                          dim_head=inner // args.heads, **cfg_kw)
+                          **cfg_kw)
         path = ckpt.save(args.out, params, step=args.epoch, config=cfg,
                          kind="dalle", meta={"imported_from": args.pth,
                                              "epoch": args.epoch})

@@ -88,9 +88,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "per optimizer step (batchSize must divide)")
     p.add_argument("--sp", type=int, default=0,
                    help="sequence-parallel mesh axis size (devices split "
-                        "dp x sp; requires zero dropout; the token axis "
-                        "shards over sp with ring attention)")
+                        "dp x sp; the token axis shards over sp with ring "
+                        "attention; dropout uses per-position keys)")
     p.add_argument("--sp_impl", default="ring", choices=["ring", "ulysses"])
+    p.add_argument("--pp", type=int, default=0,
+                   help="pipeline-parallel stage count (devices split "
+                        "dp x pp; depth/pp consecutive layers per stage, "
+                        "GPipe microbatching over ICI)")
+    p.add_argument("--pp_microbatches", type=int, default=0,
+                   help="microbatches per pipeline step (default = --pp; "
+                        "more shrinks the pp-1-tick bubble)")
     p.add_argument("--param_dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="dtype for NEW runs' params (resumed runs keep "
@@ -105,8 +112,6 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
-    if args.sp and args.sp > 1 and (args.attn_dropout or args.ff_dropout):
-        raise SystemExit("--sp requires --attn_dropout 0 --ff_dropout 0")
     mesh, metrics, profiler = setup_run(args)
 
     # -- VAE (frozen tokenizer/decoder) — the cross-CLI contract ----------
@@ -143,7 +148,16 @@ def main(argv=None):
         params = D.dalle_init(key, cfg, vae_params=vae_params,
                               dtype=jnp.dtype(args.param_dtype))
 
+    param_specs = None
+    if args.pp and args.pp > 1:
+        # stage-shard the transformer stack so each device stores only its
+        # depth/pp layer slice (plus the replicated embeddings/head)
+        from dalle_pytorch_tpu.parallel import pp_param_specs
+        if cfg.depth % args.pp:
+            raise SystemExit(f"--pp {args.pp} must divide depth {cfg.depth}")
+        param_specs = pp_param_specs(params)
     params, opt_state = setup_sharded(params, optimizer, mesh,
+                                      param_specs=param_specs,
                                       opt_state=opt_state)
 
     # -- data --------------------------------------------------------------
@@ -162,6 +176,13 @@ def main(argv=None):
         from dalle_pytorch_tpu.parallel import sp_dalle_loss_fn
         loss_fn = sp_dalle_loss_fn(cfg, mesh, batch_axis="dp",
                                    impl=args.sp_impl)
+    elif args.pp and args.pp > 1:
+        # pipeline-parallel training: depth/pp layers per stage, GPipe
+        # microbatching inside one shard_map
+        from dalle_pytorch_tpu.parallel import pp_dalle_loss_fn
+        loss_fn = pp_dalle_loss_fn(
+            cfg, mesh, dp_axis="dp",
+            num_microbatches=args.pp_microbatches or None)
     else:
         def loss_fn(params, batch, rng):
             # all-True mask, matching the reference's training call

@@ -215,7 +215,17 @@ def _axial_tables(sd, prefix: str) -> dict:
     return {"rows": tables[0], "cols": tables[1]}
 
 
-def import_dalle(sd: Dict[str, np.ndarray], image_size: int = 256):
+def _dim_head_for(inner: int, heads: int) -> int:
+    if inner % heads:
+        raise ValueError(
+            f"heads={heads} does not divide the checkpoint's attention "
+            f"inner dim {inner}; pass the head count the checkpoint was "
+            "trained with")
+    return inner // heads
+
+
+def import_dalle(sd: Dict[str, np.ndarray], image_size: int = 256,
+                 heads: int = 8):
     """-> (dalle_params, vae_params, dalle_cfg_kwargs, vae_cfg_kwargs).
 
     The reference DALLE state dict embeds the full VAE (``vae.*``) and ties
@@ -223,7 +233,11 @@ def import_dalle(sd: Dict[str, np.ndarray], image_size: int = 256):
     dalle_pytorch.py:283); both copies land in their owners here — DALLE
     owns the live table (models.dalle docstring), the VAE convs keep theirs
     for decoding. Use ``axial_compat='full_image'`` in the DALLEConfig built
-    from the returned kwargs."""
+    from the returned kwargs.
+
+    ``heads`` cannot be inferred from a fused qkv weight; pass the value the
+    checkpoint was trained with (reference default 8) — a wrong split changes
+    attention numerics silently, so non-divisible values are rejected."""
     vae_sd = _sub(sd, "vae.")
     vae_params, vae_cfg = (import_vae(vae_sd, image_size) if vae_sd
                            else (None, None))
@@ -247,7 +261,7 @@ def import_dalle(sd: Dict[str, np.ndarray], image_size: int = 256):
         "depth": depth,
         "num_text_tokens": text_emb.shape[0],
         "text_seq_len": _np(sd, "text_pos_emb.weight").shape[0],
-        "dim_head": inner // 8 if inner % 8 == 0 else inner,  # heads=8 default
+        "dim_head": _dim_head_for(inner, heads),
         "axial_compat": "full_image",
     }
     return params, vae_params, cfg, vae_cfg

@@ -36,11 +36,17 @@ def load_library(build_if_missing: bool = True):
             return _lib
         if _lib_err is not None:
             raise RuntimeError(_lib_err)
+        from dalle_pytorch_tpu.native.build import LIB, build
+        if not build_if_missing and not os.path.exists(LIB):
+            # NOT sticky: a later build_if_missing=True call (or an explicit
+            # `python -m dalle_pytorch_tpu.native.build`) can still succeed
+            raise RuntimeError(
+                f"{LIB} not built (build_if_missing=False); run "
+                "`python -m dalle_pytorch_tpu.native.build`")
         try:
-            from dalle_pytorch_tpu.native.build import LIB, build
             path = LIB
-            if build_if_missing or not os.path.exists(path):
-                path = build(quiet=True)
+            if build_if_missing:
+                path = build(quiet=True)  # no-op when fresh, rebuild if stale
             lib = ctypes.CDLL(path)
             lib.dtl_load_images.restype = ctypes.c_int
             lib.dtl_load_images.argtypes = [

@@ -158,6 +158,31 @@ def dropout(key: Optional[Array], x: Array, rate: float, train: bool) -> Array:
     return jnp.where(mask, x / keep, jnp.zeros_like(x))
 
 
+def positional_dropout(key: Optional[Array], x: Array, rate: float,
+                       train: bool, *, offset=0) -> Array:
+    """Dropout whose mask for token ``i`` (axis 1 of ``x``) is keyed by the
+    token's GLOBAL position ``offset + i``, not by the tensor's shape.
+
+    The mask is therefore invariant to how the sequence axis is sharded:
+    concatenating per-shard results (each shard passing its global start as
+    ``offset``) reproduces the unsharded mask bit-for-bit. This is what lets
+    sequence-parallel training (parallel.sequence) run the flagship
+    dropout-0.1 config with the same key discipline on every sp degree.
+    ``offset`` may be traced (e.g. ``lax.axis_index(sp) * n_local``)."""
+    if not train or rate == 0.0 or key is None:
+        return x
+    keep = 1.0 - rate
+    pos = offset + jnp.arange(x.shape[1])
+    per_pos_shape = (x.shape[0],) + x.shape[2:]
+
+    def pos_mask(p):
+        return jax.random.bernoulli(jax.random.fold_in(key, p), keep,
+                                    per_pos_shape)
+
+    mask = jnp.moveaxis(jax.vmap(pos_mask)(pos), 0, 1)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
 def neg_inf(dtype) -> Array:
     """The reference's mask fill value: -finfo(dtype).max
     (reference dalle_pytorch/transformer.py:72)."""

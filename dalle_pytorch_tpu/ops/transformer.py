@@ -160,14 +160,19 @@ def attn_branch(layer_params: dict, x: Array, mask: Optional[Array],
 
 
 def ff_branch(layer_params: dict, x: Array, cfg: TransformerConfig,
-              key: Optional[Array], train: bool) -> Array:
-    """PreNorm GEGLU feed-forward (reference transformer.py:33-49)."""
+              key: Optional[Array], train: bool,
+              dropout_fn=None) -> Array:
+    """PreNorm GEGLU feed-forward (reference transformer.py:33-49).
+    ``dropout_fn(key, h)`` overrides the default whole-tensor dropout —
+    the sequence-parallel stack passes a positional variant so the mask
+    is invariant to sequence sharding."""
     p = layer_params["ff"]
     h = core.layernorm(p["ln"], x)
     h = core.linear(p["w1"], h)
     h, gates = jnp.split(h, 2, axis=-1)
     h = h * core.gelu(gates)
-    h = core.dropout(key, h, cfg.ff_dropout, train)
+    h = (dropout_fn(key, h) if dropout_fn is not None
+         else core.dropout(key, h, cfg.ff_dropout, train))
     return core.linear(p["w2"], h)
 
 

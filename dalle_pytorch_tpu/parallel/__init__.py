@@ -12,7 +12,7 @@ parallelism over long sequences.
 from dalle_pytorch_tpu.parallel.mesh import (  # noqa: F401
     make_mesh, named_sharding, replicate, shard_batch)
 from dalle_pytorch_tpu.parallel.pipeline import (  # noqa: F401
-    pipeline_transformer)
+    pipeline_transformer, pp_dalle_loss_fn, pp_param_specs)
 from dalle_pytorch_tpu.parallel.ring import (  # noqa: F401
     ring_attention, ulysses_attention)
 from dalle_pytorch_tpu.parallel.sequence import (  # noqa: F401

@@ -58,7 +58,8 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
                          axis: str = "pp",
                          num_microbatches: Optional[int] = None,
                          dp_axis: Optional[str] = None,
-                         mask: Optional[Array] = None) -> Array:
+                         mask: Optional[Array] = None,
+                         rng=None, train: bool = False) -> Array:
     """Run the transformer stack pipelined over ``mesh.shape[axis]`` stages.
 
     params: depth-stacked layer tree (leading axis ``cfg.depth``).
@@ -67,10 +68,15 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
     mask: optional (b, n) pad mask, routed to attention per microbatch.
     dp_axis: additionally shard the microbatch dimension over this mesh
     axis (pipeline x data parallel in one program).
+    rng/train: dropout, keyed per (stage, microbatch) — deterministic for a
+    given rng, stage count, and microbatch split.
 
     Returns the same (b, n, dim) as ``transformer_apply`` on one device —
-    parity-tested on the CPU mesh. Eval semantics (dropout inert, as with
-    ``train=False``); ``reversible=True`` is rejected (different math).
+    parity-tested on the CPU mesh (grad parity too: the scan-over-ticks and
+    the ppermute both transpose). ``reversible=True`` is rejected
+    (different math). Idle ramp-up/ramp-down ticks skip the stage compute
+    with ``lax.cond`` (local control flow is legal inside shard_map; the
+    collective stays outside the branch).
     """
     from dalle_pytorch_tpu.ops.transformer import transformer_apply
 
@@ -84,9 +90,12 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
         # function; pp + reversible is a future combination
         raise NotImplementedError(
             "pipeline_transformer does not support reversible=True")
+    dropout_on = train and (cfg.attn_dropout > 0 or cfg.ff_dropout > 0)
+    if dropout_on and rng is None:
+        raise ValueError(
+            "pipeline_transformer(train=True) with nonzero dropout requires "
+            "an explicit `rng` key — JAX has no global RNG state")
     depth_per = cfg.depth // num_stages
-    # eval semantics: dropout rates in the config are inert (no train path),
-    # exactly as transformer_apply(train=False)
     stage_cfg = dataclasses.replace(
         cfg, depth=depth_per, sparse_attn=_stage_pattern(cfg, num_stages))
 
@@ -103,8 +112,10 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
     has_mask = mask is not None
     maskm = (mask.reshape(M, mb, n) if has_mask
              else jnp.ones((M, 1, 1), bool))              # dead placeholder
+    if rng is None:
+        rng = jax.random.PRNGKey(0)          # dead value (dropout off)
 
-    def stage_fn(stage_params, xm, maskm):
+    def stage_fn(stage_params, xm, maskm, rng):
         sp = jax.tree.map(lambda a: a[0], stage_params)   # local layer slice
         P_ = lax.axis_size(axis)
         idx = lax.axis_index(axis)
@@ -117,13 +128,26 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
         # and their outputs never selected)
         masks = jax.vmap(
             lambda t: maskm[jnp.clip(t - idx, 0, M - 1)])(jnp.arange(ticks))
+        rng_stage = jax.random.fold_in(rng, idx)
 
         def tick(state, xs):
-            inp, m_in = xs
+            t, inp, m_in = xs
             # stage 0 ingests the next microbatch; others use the handoff
             h = jnp.where(idx == 0, inp, state)
             m = m_in if has_mask else None
-            out = transformer_apply(sp, h, cfg=stage_cfg, mask=m)
+            mb_idx = t - idx
+            key_mb = jax.random.fold_in(rng_stage,
+                                        jnp.clip(mb_idx, 0, M - 1))
+
+            def run(h):
+                return transformer_apply(sp, h, cfg=stage_cfg, mask=m,
+                                         rng=key_mb, train=train)
+
+            # ramp-up/down ticks where this stage holds no microbatch skip
+            # the layer slice entirely (identity); the ppermute below runs
+            # unconditionally so the collective stays program-aligned
+            active = jnp.logical_and(mb_idx >= 0, mb_idx < M)
+            out = lax.cond(active, run, lambda h: h, h)
             nxt = lax.ppermute(out, axis,
                                [(i, (i + 1) % P_) for i in range(P_)])
             return nxt, out
@@ -131,7 +155,8 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
         # the carry is device-varying over pp (each stage holds a different
         # microbatch's activations) — mark the zero init accordingly
         state0 = lax.pcast(jnp.zeros_like(xm[0]), (axis,), to="varying")
-        _, outs = lax.scan(tick, state0, (stream[:ticks], masks))
+        _, outs = lax.scan(tick, state0,
+                           (jnp.arange(ticks), stream[:ticks], masks))
         # stage s finishes microbatch m at tick m + s: the last stage's
         # outputs at ticks P-1 .. M+P-2 are the final activations, in order
         final = outs[P_ - 1:]
@@ -141,6 +166,54 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
     data_spec = P(None, dp_axis) if dp_axis else P()
     mask_spec = data_spec if has_mask else P()    # placeholder: replicate
     out = shard_map(stage_fn, mesh=mesh,
-                    in_specs=(P(axis), data_spec, mask_spec),
-                    out_specs=data_spec)(stacked, xm, maskm)
+                    in_specs=(P(axis), data_spec, mask_spec, P()),
+                    out_specs=data_spec)(stacked, xm, maskm, rng)
     return out.reshape(b, n, d)
+
+
+def pp_param_specs(params, axis: str = "pp"):
+    """PartitionSpecs that shard the depth-stacked transformer over the
+    pipeline axis (each stage stores only its own depth/P layer slice; the
+    contiguous leading-axis shard is exactly the stage-major reshape inside
+    ``pipeline_transformer``) and replicate everything else. Feed to
+    ``parallel.train.setup_sharded(param_specs=...)``."""
+    return {k: (jax.tree.map(lambda _: P(axis), v) if k == "transformer"
+                else jax.tree.map(lambda _: P(), v))
+            for k, v in params.items()}
+
+
+def pp_dalle_loss_fn(cfg, mesh: Mesh, *, axis: str = "pp",
+                     dp_axis: Optional[str] = None,
+                     num_microbatches: Optional[int] = None):
+    """DALLE training loss with the transformer pipelined over ``axis`` —
+    the pp counterpart of ``parallel.sequence.sp_dalle_loss_fn``.
+
+    Batch = {'text': (b, t) ids, 'image': (b, n_img) token ids, 'mask':
+    optional (b, t) text pad mask, extended all-True over the image span
+    like the dense path (reference dalle_pytorch.py:384-388)}. Embedding
+    lookups and the CE head run under GSPMD outside the pipeline;
+    ``cfg.loss_chunk`` caps the head's logits memory as usual. Signature
+    matches ``parallel.train.make_train_step``'s
+    ``loss_fn(params, batch, rng)``.
+    """
+    from dalle_pytorch_tpu.models import dalle as D
+    if cfg.transformer.reversible:
+        raise NotImplementedError(
+            "pipeline parallelism does not support reversible=True")
+
+    def loss(params, batch, rng):
+        text, image_ids = batch["text"], batch["image"]
+        tokens = D.embed_prompt(params, cfg, text, image_ids)
+        mask = batch.get("mask")
+        if mask is not None:
+            pad = jnp.ones((mask.shape[0], image_ids.shape[1]), bool)
+            mask = jnp.concatenate([mask, pad], axis=1)
+        h = pipeline_transformer(params["transformer"], tokens,
+                                 cfg=cfg.transformer, mesh=mesh, axis=axis,
+                                 dp_axis=dp_axis,
+                                 num_microbatches=num_microbatches,
+                                 mask=mask, rng=rng, train=True)
+        # same loss tail as dalle_apply — one definition of the contract
+        return D.ce_from_hidden(params, h, text, image_ids, cfg=cfg)
+
+    return loss

@@ -13,10 +13,13 @@ sharded over a mesh axis and attention runs as an SPMD program:
     (the flash-attention recurrence, so no (n, n) matrix ever exists).
     Causal masking is block-aware: blocks wholly in the future contribute
     nothing (their weights underflow to exactly zero via the -inf mask).
-  * ``ulysses_attention`` — all-to-all re-shards sequence -> heads, runs
-    ordinary dense attention on full sequences for the local head group,
-    and all-to-alls back. One collective round-trip instead of a ring of
-    size-1 hops; better when heads >= mesh axis size and the sequence fits.
+  * ``ulysses_attention`` — all-to-all re-shards sequence -> heads, attends
+    over the full sequence for the local head group, and all-to-alls back.
+    One collective round-trip instead of a ring of size-1 hops; better when
+    heads >= mesh axis size. At long context the local attention folds the
+    key axis in chunks through the same online-softmax recurrence as the
+    ring (``kv_chunks``), so no (n, n) score matrix ever materializes on
+    either path.
 
 Both are exact (same math as dense attention) — parity tests drive them on
 the virtual CPU mesh against the single-device oracle.
@@ -149,13 +152,14 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
 
 def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
                       causal: bool = True, scale: Optional[float] = None,
-                      batch_axis: Optional[str] = None, mask=None):
+                      batch_axis: Optional[str] = None, mask=None,
+                      kv_chunks: Optional[int] = None):
     """Exact attention via head<->sequence all-to-all re-sharding.
 
     q, k, v: (b, h, n, d) global; h divides by the axis size. Inside the
     shard_map each device swaps its sequence shard for a head shard
     (all_to_all over ICI), attends over the FULL sequence for its heads,
-    then swaps back.
+    then swaps back. ``kv_chunks`` as in ``ulysses_attention_local``.
     """
     size = mesh.shape[axis]
     if q.shape[1] % size != 0:
@@ -165,7 +169,8 @@ def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
     def local(q, k, v, *m):
         return ulysses_attention_local(q, k, v, axis=axis, causal=causal,
                                        scale=scale,
-                                       mask=m[0] if m else None)
+                                       mask=m[0] if m else None,
+                                       kv_chunks=kv_chunks)
 
     return _sharded_attn(local, mesh, axis, batch_axis, q, k, v, mask)
 
@@ -183,13 +188,26 @@ def _sharded_attn(local, mesh: Mesh, axis: str, batch_axis, q, k, v, mask):
                      out_specs=spec)(*args)
 
 
+# full-sequence length at/above which the Ulysses body switches from the
+# one-einsum dense score matrix to the chunked online-softmax (the (n, n)
+# buffer is fine at bench scale but contradicts the long-context purpose)
+_ULYSSES_DENSE_MAX = 4096
+
+
 def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True,
-                            scale: Optional[float] = None, mask=None):
+                            scale: Optional[float] = None, mask=None,
+                            kv_chunks: Optional[int] = None):
     """Per-shard Ulysses body — call INSIDE a ``shard_map``; q, k, v are
     LOCAL (b, h, n/size, d) shards with h divisible by the axis size.
     ``mask`` is this shard's (b, n/size) pad mask; it is all-gathered to
     the full sequence (the heads are local here anyway) and applied with
-    dense-path semantics."""
+    dense-path semantics.
+
+    ``kv_chunks`` bounds score memory: the key/value axis is folded in that
+    many chunks through the same online-softmax recurrence as the ring path
+    (peak (b, h/size, n, n/kv_chunks) instead of (b, h/size, n, n)). None =
+    auto: dense below ``_ULYSSES_DENSE_MAX`` total sequence, one chunk per
+    ring rank at or above it. 1 = always dense."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
 
@@ -203,15 +221,48 @@ def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True,
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    s = jnp.einsum("bhid,bhjd->bhij", qh, kh) * scale
-    if mask is not None:
-        full = lax.all_gather(mask, axis, axis=1, tiled=True)   # (b, n)
-        pair = full[:, :, None] & full[:, None, :]
-        fmax = jnp.asarray(-jnp.finfo(s.dtype).max, s.dtype)
-        s = jnp.where(pair[:, None], s, fmax)
-    if causal:
-        n = s.shape[-1]
-        tri = jnp.tril(jnp.ones((n, n), bool))
-        s = jnp.where(tri[None, None], s, -jnp.inf)
-    out = jnp.einsum("bhij,bhjd->bhid", jax.nn.softmax(s, axis=-1), vh)
-    return heads_to_seq(out)
+    n = qh.shape[2]
+    size = n // q.shape[2]                       # static: n = nl * size
+    full = (lax.all_gather(mask, axis, axis=1, tiled=True)
+            if mask is not None else None)       # (b, n)
+    if kv_chunks is None:
+        kv_chunks = 1 if n < _ULYSSES_DENSE_MAX else size
+    if kv_chunks > 1 and n % kv_chunks:
+        raise ValueError(f"kv_chunks {kv_chunks} must divide the full "
+                         f"sequence {n}")
+
+    if kv_chunks == 1:
+        s = jnp.einsum("bhid,bhjd->bhij", qh, kh) * scale
+        if full is not None:
+            pair = full[:, :, None] & full[:, None, :]
+            fmax = jnp.asarray(-jnp.finfo(s.dtype).max, s.dtype)
+            s = jnp.where(pair[:, None], s, fmax)
+        if causal:
+            tri = jnp.tril(jnp.ones((n, n), bool))
+            s = jnp.where(tri[None, None], s, -jnp.inf)
+        out = jnp.einsum("bhij,bhjd->bhid", jax.nn.softmax(s, axis=-1), vh)
+        return heads_to_seq(out)
+
+    ck = n // kv_chunks
+    b, hl, _, d = qh.shape
+    ks = jnp.moveaxis(kh.reshape(b, hl, kv_chunks, ck, d), 2, 0)
+    vs = jnp.moveaxis(vh.reshape(b, hl, kv_chunks, ck, d), 2, 0)
+    rows = jnp.arange(n)
+    m0 = qh[..., :1] * 0.0 - jnp.inf
+    l0 = qh[..., :1] * 0.0
+    acc0 = qh * 0.0
+
+    def fold(carry, xs):
+        j, kb, vb = xs
+        cols = j * ck + jnp.arange(ck)
+        allow = (cols[None, :] <= rows[:, None]) if causal else \
+            jnp.ones((n, ck), bool)
+        pair_ok = None
+        if full is not None:
+            mb = lax.dynamic_slice_in_dim(full, j * ck, ck, axis=1)
+            pair_ok = full[:, :, None] & mb[:, None, :]
+        return _online_block(carry, kb, vb, qh, scale, allow, pair_ok), None
+
+    (m, l, acc), _ = lax.scan(fold, (m0, l0, acc0),
+                              (jnp.arange(kv_chunks), ks, vs))
+    return heads_to_seq(acc / jnp.where(l == 0.0, 1.0, l))

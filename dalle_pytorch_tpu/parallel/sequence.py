@@ -20,8 +20,13 @@ a shard_map per attention call.
 Pad masks are supported with dense-path semantics: mask blocks rotate
 around the ring with k/v (pad pairs fill with the finite -fmax, so padded
 rows degrade to a causal-prefix average exactly like
-ops.attention.dense_attention_weights). Restrictions (asserted): dense
-attention only, no dropout.
+ops.attention.dense_attention_weights). Dropout is supported and
+sp-degree-invariant: both dropout sites (post-attention projection, FF
+hidden) are position-local, so their masks are drawn from PER-POSITION
+keys (``core.positional_dropout`` with offset = shard start) — the same
+rng gives bit-identical masks on every sp degree, and the flagship
+dropout-0.1 config trains under ``--sp``. Restrictions (asserted): dense
+attention only, no reversible engine.
 """
 
 from __future__ import annotations
@@ -52,30 +57,37 @@ def _check_cfg(cfg: T.TransformerConfig) -> None:
     if cfg.reversible:
         raise ValueError("sequence parallelism and reversible execution "
                          "are mutually exclusive engines")
-    if cfg.attn_dropout or cfg.ff_dropout:
-        raise ValueError("dropout is not supported under sequence "
-                         "parallelism")
 
 
 def sp_transformer_apply(params, x, *, cfg: T.TransformerConfig, mesh: Mesh,
                          sp_axis: str = "sp",
                          batch_axis: Optional[str] = None,
-                         impl: str = "ring", mask=None):
+                         impl: str = "ring", mask=None,
+                         rng=None, train: bool = False):
     """Run the stack with x (b, n, dim) sequence-sharded over ``sp_axis``.
 
     Numerics match ``ops.transformer.transformer_apply`` (same prenorm
     residual bodies, same ``cfg.scale``, same pad-mask semantics — ``mask``
     is the (b, n) GLOBAL pad mask, sharded like the tokens); only the
     attention communication pattern differs. ``batch_axis`` optionally
-    shards the batch dim too (dp x sp in one mesh).
+    shards the batch dim too (dp x sp in one mesh). Dropout masks are drawn
+    per GLOBAL token position (core.positional_dropout), so the same
+    ``rng`` yields identical masks on every sp degree.
     """
     _check_cfg(cfg)
     if impl not in ("ring", "ulysses"):
         raise ValueError(f"unknown sp impl {impl!r}")
+    dropout_on = train and (cfg.attn_dropout > 0 or cfg.ff_dropout > 0)
+    if dropout_on and rng is None:
+        raise ValueError(
+            "sp_transformer_apply(train=True) with nonzero dropout requires "
+            "an explicit `rng` key — JAX has no global RNG state")
     size = mesh.shape[sp_axis]
     if x.shape[1] % size != 0:
         raise ValueError(f"seq len {x.shape[1]} not divisible by "
                          f"{sp_axis} axis ({size})")
+    n_local = x.shape[1] // size
+    keys = T._layer_keys(rng, cfg.depth)
 
     def attend(q, k, v, mb):
         if impl == "ring":
@@ -86,25 +98,38 @@ def sp_transformer_apply(params, x, *, cfg: T.TransformerConfig, mesh: Mesh,
                                        causal=cfg.causal, scale=cfg.scale,
                                        mask=mb)
 
-    def stack(params, x, mb):
-        def body(h, lp):
+    def stack(params, keys, x, mb):
+        # absolute position of this shard's first token — the dropout keys
+        # depend on it, not on the shard index count, hence sp-invariance
+        offset = lax.axis_index(sp_axis) * n_local
+
+        def body(h, xs):
+            lp, lkeys = xs
             a_in = core.layernorm(lp["attn"]["ln"], h)
             q, k, v = attn_ops.qkv_project(lp["attn"], a_in, cfg.heads)
             o = attend(q, k, v, mb)
-            h = h + attn_ops.output_tail(lp["attn"], o)
-            h = h + T.ff_branch(lp, h, cfg, None, False)
+            a_out = attn_ops.output_tail(lp["attn"], o)
+            a_out = core.positional_dropout(lkeys[0], a_out,
+                                            cfg.attn_dropout, train,
+                                            offset=offset)
+            h = h + a_out
+            h = h + T.ff_branch(
+                lp, h, cfg, lkeys[1], train,
+                dropout_fn=lambda k, t: core.positional_dropout(
+                    k, t, cfg.ff_dropout, train, offset=offset))
             return h, None
 
-        out, _ = lax.scan(body, x, params)
+        out, _ = lax.scan(body, x, (params, keys))
         return out
 
     x_spec = P(batch_axis, sp_axis, None)
     m_spec = P(batch_axis, sp_axis)
     if mask is None:
-        return shard_map(lambda p, x: stack(p, x, None), mesh=mesh,
-                         in_specs=(P(), x_spec), out_specs=x_spec)(params, x)
-    return shard_map(stack, mesh=mesh, in_specs=(P(), x_spec, m_spec),
-                     out_specs=x_spec)(params, x, mask)
+        return shard_map(lambda p, k, x: stack(p, k, x, None), mesh=mesh,
+                         in_specs=(P(), P(), x_spec),
+                         out_specs=x_spec)(params, keys, x)
+    return shard_map(stack, mesh=mesh, in_specs=(P(), P(), x_spec, m_spec),
+                     out_specs=x_spec)(params, keys, x, mask)
 
 
 def sp_dalle_loss_fn(cfg, mesh: Mesh, *, sp_axis: str = "sp",
@@ -134,7 +159,7 @@ def sp_dalle_loss_fn(cfg, mesh: Mesh, *, sp_axis: str = "sp",
         h = sp_transformer_apply(params["transformer"], tokens,
                                  cfg=cfg.transformer, mesh=mesh,
                                  sp_axis=sp_axis, batch_axis=batch_axis,
-                                 impl=impl, mask=mask)
+                                 impl=impl, mask=mask, rng=rng, train=True)
         # same loss tail as dalle_apply — one definition of the contract
         return D.ce_from_hidden(params, h, text, image_ids, cfg=cfg)
 

@@ -84,14 +84,19 @@ def accumulate_grads(loss_fn: Callable, params, batch: dict, rng,
         loss_acc, grads_acc = carry
         loss_i, grads_i = jax.value_and_grad(loss_fn)(
             params, {**mb, **rest}, jax.random.fold_in(rng, i))
-        grads_acc = jax.tree.map(jnp.add, grads_acc, grads_i)
+        grads_acc = jax.tree.map(
+            lambda a, g: a + g.astype(jnp.float32), grads_acc, grads_i)
         return (loss_acc + loss_i, grads_acc), None
 
-    zeros = jax.tree.map(jnp.zeros_like, params)
+    # accumulate in f32 even under --param_dtype bfloat16: bf16 summation
+    # across microbatches compounds rounding error as grad_accum grows
+    zeros = jax.tree.map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
     (loss, grads), _ = jax.lax.scan(
         body, (jnp.float32(0.0), zeros), (jnp.arange(grad_accum), micro))
     inv = 1.0 / grad_accum
-    return loss * inv, jax.tree.map(lambda g: g * inv, grads)
+    return loss * inv, jax.tree.map(
+        lambda g, p: (g * inv).astype(p.dtype), grads, params)
 
 
 def setup_sharded(params, optimizer, mesh: Mesh, param_specs=None,

@@ -51,7 +51,9 @@ class MetricsLogger:
         now = time.perf_counter()
         dt = max(now - self._t_last, 1e-9)
         rate = self._units_since / dt
-        n_dev = max(self.n_devices or jax.device_count(), 1)
+        # rate is host-local, so the default denominator must be too —
+        # jax.device_count() would understate per-chip by process_count
+        n_dev = max(self.n_devices or jax.local_device_count(), 1)
         rec = {
             "step": step, "loss": float(loss),
             f"{unit_name}_per_sec": round(rate * self.process_count, 2),

@@ -34,7 +34,11 @@ def chain(x):
 
 
 def main():
-    print("backend:", jax.default_backend(), jax.devices())
+    import json
+    import sys
+    as_json = "--json" in sys.argv
+    if not as_json:
+        print("backend:", jax.default_backend(), jax.devices())
     x = jax.random.normal(jax.random.PRNGKey(0), (N, N), jnp.bfloat16)
     f = jax.jit(chain)
     y = f(x)
@@ -50,11 +54,23 @@ def main():
     _ = float(np.asarray(y[0, 0]))
     dt_fetch = time.perf_counter() - t0
 
+    peak = 197.0  # v5e bf16
+    if as_json:
+        # machine-readable line for scripts/tpu_smoke.sh
+        print(json.dumps({
+            "backend": jax.default_backend(),
+            "block_ms": round(dt_block * 1e3, 1),
+            "fetch_ms": round(dt_fetch * 1e3, 1),
+            "block_tflops": round(FLOPS / dt_block / 1e12, 1),
+            "fetch_tflops": round(FLOPS / dt_fetch / 1e12, 1),
+            "peak_tflops": peak,
+            "block_sync_broken": FLOPS / dt_block / 1e12 > peak * 1.5,
+        }))
+        return
     print(f"block_until_ready: {dt_block*1e3:8.1f} ms  "
           f"-> {FLOPS/dt_block/1e12:9.1f} TFLOP/s")
     print(f"host fetch:        {dt_fetch*1e3:8.1f} ms  "
           f"-> {FLOPS/dt_fetch/1e12:9.1f} TFLOP/s")
-    peak = 197.0  # v5e bf16
     if FLOPS / dt_block / 1e12 > peak * 1.5:
         print("CONFIRMED: block_until_ready returned before execution "
               "finished (apparent TFLOP/s above physical peak) — timed "

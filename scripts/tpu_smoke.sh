@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# TPU hardware smoke (VERDICT r3 item 8): re-validate the compiled Pallas
+# kernels and the host-sync timing discipline on a healthy chip in <2 min,
+# leaving a committed papertrail.
+#
+# Run on the TPU machine from the repo root:
+#   bash scripts/tpu_smoke.sh
+#
+# Writes docs/TPU_SMOKE_<date>.json with:
+#   * bench.py --config kernels   — flash + block-sparse fwd/bwd rel-diffs,
+#     compiled on-chip (interpreted must be false, parity_ok true)
+#   * axon_sync_repro.py          — block_until_ready vs host-fetch TFLOP/s
+#     (fetch-synced number must be <= the chip's bf16 peak)
+# Exit 0 only when both checks hold. Commit the JSON.
+set -u
+cd "$(dirname "$0")/.."
+out="docs/TPU_SMOKE_$(date -u +%Y-%m-%d).json"
+
+kernels=$(python bench.py --config kernels 2>/dev/null | tail -1)
+sync=$(python scripts/axon_sync_repro.py --json 2>/dev/null | tail -1)
+
+python - "$out" "$kernels" "$sync" <<'EOF'
+import json, sys
+out, kernels_raw, sync_raw = sys.argv[1], sys.argv[2], sys.argv[3]
+rec = {"kernels": None, "sync": None, "ok": False}
+problems = []
+try:
+    k = json.loads(kernels_raw)
+    rec["kernels"] = k
+    if k.get("interpreted") is not False:
+        problems.append("kernels ran interpreted (not compiled on-chip)")
+    if k.get("parity_ok") is not True:
+        problems.append("kernel parity failed")
+except Exception as e:
+    problems.append(f"kernels config unparseable: {e}: {kernels_raw[:200]}")
+try:
+    s = json.loads(sync_raw)
+    rec["sync"] = s
+    if s.get("fetch_tflops", 1e9) > s.get("peak_tflops", 0):
+        problems.append("fetch-synced TFLOP/s above physical peak")
+except Exception as e:
+    problems.append(f"sync repro unparseable: {e}: {sync_raw[:200]}")
+rec["ok"] = not problems
+rec["problems"] = problems
+with open(out, "w") as f:
+    json.dump(rec, f, indent=2)
+print(json.dumps({"ok": rec["ok"], "problems": problems, "wrote": out}))
+sys.exit(0 if rec["ok"] else 1)
+EOF

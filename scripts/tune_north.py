@@ -23,7 +23,23 @@ def main():
     ap.add_argument("--batches", default="8,16,32")
     ap.add_argument("--loss_chunks", default="0",
                     help="comma list; 0 = dense CE head")
+    ap.add_argument("--claim_retries", type=int, default=20,
+                    help="re-exec for a fresh chip claim this many times "
+                         "when backend init stalls/errors (wedged-tunnel "
+                         "resilience, same pattern as bench.py)")
     args = ap.parse_args()
+
+    # Backend init via bench.py's shared deadline + re-exec helper; retry
+    # timeouts too, with long backoff — the sweep is a background job that
+    # should wait out a tunnel outage rather than give up.
+    from bench import claim_backend
+    claim = claim_backend(args.claim_retries, attempt_env="TUNE_ATTEMPT",
+                          retry_on_timeout=True,
+                          backoff=lambda a: min(60 * (a + 1), 300))
+    if claim is not None:
+        print(json.dumps({"error": claim[0], "claim_attempts": claim[1]}),
+              flush=True)
+        os._exit(1)
 
     import jax
 

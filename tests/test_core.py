@@ -78,3 +78,24 @@ def test_dropout_train_eval(key):
     assert 0.4 < frac < 0.6
     kept = np.array(y[y != 0])
     np.testing.assert_allclose(kept, 2.0, atol=1e-6)
+
+
+def test_positional_dropout_shard_invariant(key):
+    """Concatenating per-shard results (each shard passing its global start
+    offset) must reproduce the unsharded mask bit-for-bit — the property
+    sequence-parallel dropout is built on."""
+    x = jnp.arange(2 * 16 * 4, dtype=jnp.float32).reshape(2, 16, 4) + 1.0
+    full = core.positional_dropout(key, x, 0.3, train=True)
+    shards = [core.positional_dropout(key, x[:, s:s + 4], 0.3, train=True,
+                                      offset=s)
+              for s in range(0, 16, 4)]
+    np.testing.assert_array_equal(np.asarray(full),
+                                  np.concatenate([np.asarray(s) for s in
+                                                  shards], axis=1))
+    # eval / rate-0 passthrough and scaling, like plain dropout
+    assert np.array_equal(core.positional_dropout(key, x, 0.3, train=False),
+                          x)
+    kept = np.asarray(full)[np.asarray(full) != 0]
+    np.testing.assert_allclose(kept,
+                               (np.asarray(x)[np.asarray(full) != 0]) / 0.7,
+                               rtol=1e-6)
