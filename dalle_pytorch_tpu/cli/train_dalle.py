@@ -81,8 +81,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="alternate sparse/dense attention layers")
     p.add_argument("--attn_impl", type=str, default="xla",
                    choices=["xla", "flash"])
-    p.add_argument("--sparse_impl", type=str, default="ref",
-                   choices=["ref", "pallas"])
+    p.add_argument("--sparse_impl", type=str, default="windowed",
+                   choices=["ref", "windowed", "pallas"],
+                   help="'windowed' is the exact fast path (block-diagonal "
+                        "+ global strip, ~16x fewer FLOPs at seq 1280)")
     p.add_argument("--grad_accum", type=int, default=1,
                    help="accumulate gradients over this many microbatches "
                         "per optimizer step (batchSize must divide)")

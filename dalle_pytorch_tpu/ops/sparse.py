@@ -102,3 +102,87 @@ def sparse_attention_ref(q: Array, k: Array, v: Array, *, scale: float,
     dots = jnp.where(structural, dots, -jnp.inf)
     attn = jax.nn.softmax(dots, axis=-1)
     return jnp.einsum("bhij,bhjd->bhid", attn, v)
+
+
+def sparse_attention_windowed(q: Array, k: Array, v: Array, *, scale: float,
+                              causal: bool, block: int = 16,
+                              mask: Optional[Array] = None,
+                              num_local_blocks: int = 4,
+                              global_blocks: Tuple[int, ...] = (0,)) -> Array:
+    """Exact VariableSparsity attention via its algebraic structure.
+
+    The layout is (same non-overlapping window) | (global block columns)
+    [& causal], so each query row's allowed columns are its own W-token
+    window plus the G global tokens. Computing a block-diagonal (W, W)
+    window piece and a narrow (n, G) global strip and softmaxing ONCE over
+    the concatenated (W + G) columns reproduces ``sparse_attention_ref``
+    bit-for-bit semantics (same two-fill masking) while doing n*(W+G)
+    work instead of n^2 — at the reference layout (block 16, window 4
+    blocks, one global block) and seq 1280 that is a 16x FLOP cut, in the
+    autodiff BACKWARD too, with nothing but dense MXU-friendly einsums (no
+    custom kernel, no (n, n) buffer). This is the fast training path; the
+    Pallas kernel (ops.block_sparse) and the dense oracle remain as the
+    cross-checked alternatives.
+    """
+    b, h, n, d = q.shape
+    W = num_local_blocks * block
+    gcols = np.concatenate([np.arange(g * block, (g + 1) * block)
+                            for g in global_blocks])
+    if (gcols >= n).any():
+        raise ValueError(f"global blocks {global_blocks} out of range for "
+                         f"seq {n} (block {block})")
+    G = len(gcols)
+    pad = (-n) % W
+    if pad:
+        q, k, v = (jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                   for x in (q, k, v))
+    n_p = n + pad
+    nw = n_p // W
+    fill = core.neg_inf(jnp.float32)
+
+    qw = q.reshape(b, h, nw, W, d)
+    kw = k.reshape(b, h, nw, W, d)
+    vw = v.reshape(b, h, nw, W, d)
+
+    # window piece: block-diagonal (W, W) scores
+    s_w = jnp.einsum("bhwid,bhwjd->bhwij", qw, kw,
+                     preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        mw = jnp.pad(mask, ((0, 0), (0, pad)))  # pad keys masked (keys-only
+        mw = mw.reshape(b, 1, nw, 1, W)         # contract, ref :120-122)
+        s_w = jnp.where(mw, s_w, fill)
+    rows_w = np.arange(W)[:, None]
+    cols_w = np.arange(W)[None, :]
+    colidx = (np.arange(nw)[:, None, None] * W
+              + cols_w[None])                   # (nw, 1, W) absolute col
+    allow_w = np.broadcast_to(colidx < n, (nw, W, W))
+    if causal:
+        allow_w = allow_w & (cols_w <= rows_w)[None]
+    s_w = jnp.where(jnp.asarray(allow_w)[None, None], s_w, -jnp.inf)
+
+    # global strip: every row vs the G global columns
+    kg = k[:, :, gcols]
+    vg = v[:, :, gcols]
+    s_g = jnp.einsum("bhid,bhgd->bhig", q, kg,
+                     preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s_g = jnp.where(mask[:, gcols][:, None, None, :], s_g, fill)
+    rows = np.arange(n_p)[:, None]
+    # columns already counted by the row's own window must not double-count
+    allow_g = (gcols[None, :] // W) != (rows // W)
+    if causal:
+        allow_g = allow_g & (gcols[None, :] <= rows)
+    s_g = jnp.where(jnp.asarray(allow_g)[None, None], s_g, -jnp.inf)
+
+    # one safe softmax over the union of both pieces' columns
+    s_cat = jnp.concatenate([s_w, s_g.reshape(b, h, nw, W, G)], axis=-1)
+    m = s_cat.max(axis=-1, keepdims=True)
+    p = jnp.exp(s_cat - jnp.where(jnp.isfinite(m), m, 0.0))
+    p = jnp.where(jnp.isfinite(s_cat), p, 0.0)
+    l = p.sum(axis=-1, keepdims=True)
+    v_cat = jnp.concatenate(
+        [vw, jnp.broadcast_to(vg[:, :, None], (b, h, nw, G, d))], axis=3)
+    out = jnp.einsum("bhwij,bhwjd->bhwid", p.astype(v_cat.dtype), v_cat,
+                     preferred_element_type=jnp.float32)
+    out = out / jnp.where(l == 0.0, 1.0, l)
+    return out.reshape(b, h, n_p, d)[:, :, :n].astype(q.dtype)

@@ -141,13 +141,17 @@ def attn_branch(layer_params: dict, x: Array, mask: Optional[Array],
             out = block_sparse_attention(q, k, v, scale=cfg.scale,
                                          causal=cfg.causal, mask=kp_mask,
                                          block=block)
+        elif cfg.sparse_impl == "windowed":
+            out = sparse.sparse_attention_windowed(
+                q, k, v, scale=cfg.scale, causal=cfg.causal, mask=kp_mask,
+                block=block)
         elif cfg.sparse_impl == "ref":
             out = sparse.sparse_attention_ref(q, k, v, scale=cfg.scale,
                                              causal=cfg.causal, mask=kp_mask,
                                              block=block)
         else:
             raise ValueError(f"unknown sparse impl {cfg.sparse_impl!r}; "
-                             f"expected 'ref' or 'pallas'")
+                             f"expected 'ref', 'windowed', or 'pallas'")
         out = out[:, :, :n]          # drop pad rows before the tail matmul
         return attn_ops.output_tail(p, out, dropout_rate=cfg.attn_dropout,
                                     dropout_key=key, train=train)

@@ -12,32 +12,54 @@
 #   * axon_sync_repro.py          — block_until_ready vs host-fetch TFLOP/s
 #     (fetch-synced number must be <= the chip's bf16 peak)
 # Exit 0 only when both checks hold. Commit the JSON.
+#
+# Wedged-tunnel behavior: bench fails fast via its own claim deadline (its
+# stale-artifact fallback is REJECTED here — a smoke must measure, not
+# recall), and the sync repro runs under timeout(1) so a pending claim
+# cannot hang the probe for the tunnel's ~25-min pend.
 set -u
 cd "$(dirname "$0")/.."
 out="docs/TPU_SMOKE_$(date -u +%Y-%m-%d).json"
+deadline=${BENCH_INIT_DEADLINE_S:-600}
 
-kernels=$(python bench.py --config kernels 2>/dev/null | tail -1)
-sync=$(python scripts/axon_sync_repro.py --json 2>/dev/null | tail -1)
+# no pipes here: $? must be the python/timeout status, not tail's
+kernels=$(python bench.py --config kernels 2>/dev/null)
+kernels_rc=$?
+kernels=$(printf '%s\n' "$kernels" | tail -1)
+sync=$(timeout "$((deadline + 120))" python scripts/axon_sync_repro.py \
+       --json 2>/dev/null)
+sync_rc=$?
+sync=$(printf '%s\n' "$sync" | tail -1)
 
-python - "$out" "$kernels" "$sync" <<'EOF'
+python - "$out" "$kernels" "$kernels_rc" "$sync" "$sync_rc" <<'EOF'
 import json, sys
-out, kernels_raw, sync_raw = sys.argv[1], sys.argv[2], sys.argv[3]
+out, kernels_raw, kernels_rc, sync_raw, sync_rc = sys.argv[1:6]
 rec = {"kernels": None, "sync": None, "ok": False}
 problems = []
 try:
     k = json.loads(kernels_raw)
     rec["kernels"] = k
-    if k.get("interpreted") is not False:
-        problems.append("kernels ran interpreted (not compiled on-chip)")
-    if k.get("parity_ok") is not True:
-        problems.append("kernel parity failed")
+    if k.get("stale"):
+        problems.append("bench returned its stale fallback artifact "
+                        "(tunnel wedged) — not a fresh kernels run")
+    elif int(kernels_rc) != 0:
+        problems.append(f"bench --config kernels exited {kernels_rc}")
+    else:
+        if k.get("interpreted") is not False:
+            problems.append("kernels ran interpreted (not compiled on-chip)")
+        if k.get("parity_ok") is not True:
+            problems.append("kernel parity failed")
 except Exception as e:
     problems.append(f"kernels config unparseable: {e}: {kernels_raw[:200]}")
 try:
-    s = json.loads(sync_raw)
-    rec["sync"] = s
-    if s.get("fetch_tflops", 1e9) > s.get("peak_tflops", 0):
-        problems.append("fetch-synced TFLOP/s above physical peak")
+    if int(sync_rc) != 0:
+        problems.append(f"sync repro exited {sync_rc} "
+                        "(124 = timeout: tunnel claim pending?)")
+    else:
+        s = json.loads(sync_raw)
+        rec["sync"] = s
+        if s.get("fetch_tflops", 1e9) > s.get("peak_tflops", 0):
+            problems.append("fetch-synced TFLOP/s above physical peak")
 except Exception as e:
     problems.append(f"sync repro unparseable: {e}: {sync_raw[:200]}")
 rec["ok"] = not problems

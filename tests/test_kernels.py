@@ -273,3 +273,55 @@ class TestBf16Operands:
         rel = float(jnp.max(jnp.abs(o.astype(jnp.float32) - r))
                     / jnp.max(jnp.abs(r)))
         assert rel < 2e-2, rel
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_windowed_sparse_matches_oracle(key, causal):
+    q, k, v = _qkv(key, n=256)
+    out = sparse.sparse_attention_windowed(q, k, v, scale=0.2, causal=causal,
+                                           block=16)
+    ref = sparse.sparse_attention_ref(q, k, v, scale=0.2, causal=causal,
+                                      block=16)
+    np.testing.assert_allclose(np.array(out), np.array(ref), atol=2e-5)
+
+
+def test_windowed_sparse_ragged_and_mask_matches_oracle(key):
+    """n not a multiple of the 64-token window (but a block multiple, as
+    the transformer guarantees) + ragged pad-key mask."""
+    q, k, v = _qkv(key, n=176)                       # 11 blocks, 2.75 windows
+    mask = jnp.ones((2, 176), bool).at[0, 150:].set(False) \
+                                   .at[1, 16:].set(False)
+    out = sparse.sparse_attention_windowed(q, k, v, scale=0.2, causal=True,
+                                           mask=mask, block=16)
+    ref = sparse.sparse_attention_ref(q, k, v, scale=0.2, causal=True,
+                                      mask=mask, block=16)
+    np.testing.assert_allclose(np.array(out), np.array(ref), atol=2e-5)
+
+
+def test_windowed_sparse_gradients_match_oracle(key):
+    q, k, v = _qkv(key, n=128)
+    tgt = jax.random.normal(key, q.shape)
+
+    def loss_win(q, k, v):
+        o = sparse.sparse_attention_windowed(q, k, v, scale=0.2, causal=True,
+                                             block=16)
+        return jnp.sum((o - tgt) ** 2)
+
+    def loss_ref(q, k, v):
+        o = sparse.sparse_attention_ref(q, k, v, scale=0.2, causal=True,
+                                        block=16)
+        return jnp.sum((o - tgt) ** 2)
+
+    gw = jax.grad(loss_win, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gw, gr):
+        np.testing.assert_allclose(np.array(a), np.array(b), atol=5e-4)
+
+
+def test_windowed_sparse_multiple_global_blocks(key):
+    q, k, v = _qkv(key, n=256)
+    out = sparse.sparse_attention_windowed(q, k, v, scale=0.2, causal=True,
+                                           block=16, global_blocks=(0, 5))
+    ref = sparse.sparse_attention_ref(q, k, v, scale=0.2, causal=True,
+                                      block=16, global_blocks=(0, 5))
+    np.testing.assert_allclose(np.array(out), np.array(ref), atol=2e-5)
