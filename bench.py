@@ -263,13 +263,32 @@ def bench_north(args):
 
     n_dev = len(jax.devices())
     mesh = make_mesh({"dp": n_dev})
-    batch = args.batch if args.batch else (8 * n_dev if not args.tiny else 4)
+
+    # tuned defaults from the last committed scripts/tune_north.py sweep
+    # (docs/TUNE_NORTH.json); explicit flags always win, and the file only
+    # applies on the backend it was measured on
+    tuned = {}
+    if not args.tiny:
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(
+                    __file__)), "docs", "TUNE_NORTH.json")) as f:
+                payload = json.load(f)
+            if payload.get("backend") == jax.default_backend():
+                tuned = payload.get("best", {})
+        except (OSError, ValueError):
+            pass
+    batch = args.batch or (tuned.get("batch_per_chip", 8) * n_dev
+                           if not args.tiny else 4)
+    loss_chunk = args.loss_chunk
+    if loss_chunk is None:
+        loss_chunk = tuned.get("loss_chunk") or 0
 
     attn = args.attn
     if attn == "auto":
-        attn = "flash" if jax.default_backend() == "tpu" else "xla"
+        attn = tuned.get("attn") or (
+            "flash" if jax.default_backend() == "tpu" else "xla")
     cfg = build_cfg(args.tiny, depth=12 if not args.tiny else 2,
-                    attn_impl=attn, loss_chunk=args.loss_chunk)
+                    attn_impl=attn, loss_chunk=loss_chunk)
     note = None
     _progress(f"north: compiling train step (attn={attn}, batch={batch})")
     try:
@@ -282,7 +301,7 @@ def bench_north(args):
         note = f"flash kernel failed ({type(e).__name__}), xla path"
         attn = "xla"
         cfg = build_cfg(args.tiny, depth=12 if not args.tiny else 2,
-                        attn_impl="xla")
+                        attn_impl="xla", loss_chunk=loss_chunk)
         step, params, opt_state, data, key = setup_train(cfg, batch, mesh)
         dt, loss, params = time_steps(step, params, opt_state, data, key,
                                       args.warmup, args.steps)
@@ -617,9 +636,10 @@ def main():
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--batch", type=int, default=0)
     ap.add_argument("--gen_reps", type=int, default=5)
-    ap.add_argument("--loss_chunk", type=int, default=0,
+    ap.add_argument("--loss_chunk", type=int, default=None,
                     help="chunked-CE head size for the north config "
-                         "(0 = dense)")
+                         "(0 = dense; default: the committed tuned value, "
+                         "else dense)")
     ap.add_argument("--no_gen", action="store_true",
                     help="skip the generate-latency half")
     ap.add_argument("--retries", type=int, default=3)

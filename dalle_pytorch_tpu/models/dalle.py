@@ -268,9 +268,18 @@ def ce_from_hidden(params: dict, h: Array, text: Array, image_ids: Array, *,
     logits = to_logits(params, h)
     forbidden = logits_mask(cfg)[:h.shape[1]]
     logits = jnp.where(forbidden[None], core.neg_inf(logits.dtype), logits)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(_nll(logits, targets))
+
+
+def _nll(logits: Array, targets: Array) -> Array:
+    """-log_softmax(logits)[targets] as logsumexp - gathered logit: same
+    math, but the full-vocab f32 log-probability tensor (the largest buffer
+    in the dense CE head — (b, 1280, 12k) f32 at bench shape) never
+    materializes; only the (b, n) reductions do."""
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None],
+                              axis=-1)[..., 0].astype(jnp.float32)
+    return lse - tgt
 
 
 def _chunked_ce(params: dict, h: Array, targets: Array,
@@ -303,9 +312,7 @@ def _chunked_ce(params: dict, h: Array, targets: Array,
         hc, tc, fc, vc = xs
         logits = to_logits(params, hc)
         logits = jnp.where(fc[None], core.neg_inf(logits.dtype), logits)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
-        return acc + jnp.sum(nll * vc[None]), None
+        return acc + jnp.sum(_nll(logits, tc) * vc[None]), None
 
     total, _ = lax.scan(jax.checkpoint(body), jnp.float32(0.0),
                         (h_c, t_c, f_c, v_c))

@@ -19,30 +19,50 @@ OUT=docs/demo
 DATA=data/demo
 mkdir -p "$OUT"
 
+# Scale knobs (defaults = the real chip run; the CPU rehearsal in CI-ish
+# form is IMG_N=48 IMG_SIZE=32 VAE_EPOCHS=1 DALLE_EPOCHS=1 DIM=32 DEPTH=2
+# TOKENS=64 CDIM=32 HID=16 LAYERS=2)
 VAE_EPOCHS=${VAE_EPOCHS:-16}
 DALLE_EPOCHS=${DALLE_EPOCHS:-24}
+IMG_N=${IMG_N:-600}
+IMG_SIZE=${IMG_SIZE:-128}
+DIM=${DIM:-256}
+DEPTH=${DEPTH:-6}
+TOKENS=${TOKENS:-1024}
+CDIM=${CDIM:-256}
+HID=${HID:-64}
+LAYERS=${LAYERS:-3}
 
-[ -d "$DATA/images/0" ] || \
+# rebuild the dataset whenever the size/count knobs differ from what the
+# existing one was built with (a 32px rehearsal set must not feed a 128px
+# training run)
+stamp="$DATA/.stamp_${IMG_N}_${IMG_SIZE}"
+if [ ! -f "$stamp" ]; then
+  rm -rf "$DATA"
   PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
-  python scripts/make_demo_dataset.py --out "$DATA" --n 600 --size 128
+  python scripts/make_demo_dataset.py --out "$DATA" --n "$IMG_N" \
+    --size "$IMG_SIZE"
+  touch "$stamp"
+fi
 
 echo "== train_vae ($VAE_EPOCHS epochs) =="
 python -m dalle_pytorch_tpu.cli.train_vae \
-  --dataPath "$DATA/images" --imageSize 128 --batchSize 16 \
-  --n_epochs "$VAE_EPOCHS" --name demovae --num_tokens 1024 \
-  --codebook_dim 256 --hidden_dim 64 --num_layers 3 --lr 3e-4 \
-  --tempsched --models_dir models --results_dir "$OUT" \
+  --dataPath "$DATA/images" --imageSize "$IMG_SIZE" --batchSize 16 \
+  --n_epochs "$VAE_EPOCHS" --name demovae --num_tokens "$TOKENS" \
+  --codebook_dim "$CDIM" --hidden_dim "$HID" --num_layers "$LAYERS" \
+  --lr 3e-4 --tempsched --models_dir models --results_dir "$OUT" \
   --metrics "$OUT/vae_loss.jsonl" --log_interval 10
 
 echo "== train_dalle ($DALLE_EPOCHS epochs) =="
 python -m dalle_pytorch_tpu.cli.train_dalle \
-  --dataPath "$DATA/images" --imageSize 128 --batchSize 16 \
+  --dataPath "$DATA/images" --imageSize "$IMG_SIZE" --batchSize 16 \
   --captions_only "$DATA/only.txt" --captions "$DATA/captions.txt" \
   --vaename demovae --vae_epoch "$((VAE_EPOCHS - 1))" --name demodalle \
-  --n_epochs "$DALLE_EPOCHS" --dim 256 --depth 6 --heads 8 --dim_head 32 \
-  --num_text_tokens 64 --text_seq_len 32 --attn_dropout 0.1 \
-  --ff_dropout 0.1 --lr 3e-4 --models_dir models --results_dir "$OUT" \
-  --metrics "$OUT/dalle_loss.jsonl" --log_interval 10 --sample_every 8
+  --n_epochs "$DALLE_EPOCHS" --dim "$DIM" --depth "$DEPTH" --heads 8 \
+  --dim_head "$((DIM / 8))" --num_text_tokens 64 --text_seq_len 32 \
+  --attn_dropout 0.1 --ff_dropout 0.1 --lr 3e-4 --models_dir models \
+  --results_dir "$OUT" --metrics "$OUT/dalle_loss.jsonl" \
+  --log_interval 10 --sample_every 8
 
 echo "== gen_dalle =="
 for prompt in "a photo of a purple flower" \

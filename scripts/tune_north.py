@@ -69,7 +69,8 @@ def main():
                 continue
             tps = args.steps * batch * cfg.seq_len / dt / n_dev
             mfu = tps * dalle_train_flops_per_token(cfg) / peak
-            rec = {"attn": attn, "batch": batch, "loss_chunk": chunk,
+            rec = {"attn": attn, "batch": batch,
+                   "batch_per_chip": batch // n_dev, "loss_chunk": chunk,
                    "tokens_sec_chip": round(tps, 1), "mfu": round(mfu, 4),
                    "loss": round(loss, 4),
                    "setup_s": round(time.time() - t0 - dt, 1)}
@@ -79,6 +80,15 @@ def main():
     if results:
         best = max(results, key=lambda r: r["tokens_sec_chip"])
         print(json.dumps({"best": best}), flush=True)
+        # bench.py reads this as its north-config defaults (bench_north);
+        # committing it is how a sweep's winner becomes the recorded config
+        if jax.default_backend() == "tpu":
+            out = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "docs", "TUNE_NORTH.json")
+            with open(out, "w") as f:
+                json.dump({"best": best, "results": results,
+                           "backend": jax.default_backend()}, f, indent=2)
+            print(json.dumps({"wrote": out}), flush=True)
 
 
 if __name__ == "__main__":
