@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Bank the next healthy TPU-tunnel window (2026-07-30 outage pattern: the
+# tunnel wedges for hours, then recovers without notice — r3 lost a whole
+# round's hardware evidence to this; r4 runs this orchestrator detached).
+#
+#   nohup bash scripts/healthy_window.sh > /tmp/healthy_window.log 2>&1 &
+#
+# Probes the chip claim cheaply in a loop (bench's claim deadline applies
+# inside each step anyway), then runs the round's hardware agenda in
+# priority order, continuing past per-step failures:
+#   1. scripts/tune_north.py  — sweep, writes docs/TUNE_NORTH.json
+#   2. python bench.py        — full artifact with tuned defaults,
+#                               saved to docs/BENCH_TPU_<date>.json
+#   3. scripts/tpu_smoke.sh   — compiled-kernel + sync papertrail
+#   4. scripts/profile_north.py — where the step time goes
+#   5. scripts/tpu_demo.sh    — end-to-end trained proof
+# Nothing is committed automatically — inspect and commit the artifacts.
+set -u
+cd "$(dirname "$0")/.."
+stamp() { date -u +"%H:%M:%S"; }
+
+echo "[$(stamp)] waiting for a healthy tunnel (10-min probe deadline/try)"
+until BENCH_INIT_DEADLINE_S=${BENCH_INIT_DEADLINE_S:-600} \
+      python - <<'EOF'
+import os, sys, threading
+ok = {}
+def probe():
+    try:
+        import jax
+        ok["d"] = jax.devices()
+    except Exception:
+        pass
+t = threading.Thread(target=probe, daemon=True)
+t.start()
+t.join(float(os.environ.get("BENCH_INIT_DEADLINE_S", "600")))
+sys.stdout.flush()
+os._exit(0 if "d" in ok else 1)
+EOF
+do
+  echo "[$(stamp)] still wedged; sleeping 120s"
+  sleep 120
+done
+echo "[$(stamp)] tunnel healthy — running the agenda"
+
+echo "[$(stamp)] == 1/5 tune_north =="
+python scripts/tune_north.py --attns xla,flash --batches 16,32,64 \
+  --loss_chunks 0,256 --claim_retries 2 \
+  && echo "[$(stamp)] tune OK" || echo "[$(stamp)] tune FAILED"
+
+echo "[$(stamp)] == 2/5 full bench =="
+out="docs/BENCH_TPU_$(date -u +%Y-%m-%d_%H%M).json"
+if python bench.py > /tmp/bench_window.json 2>/tmp/bench_window.err; then
+  python -c "
+import json
+d = json.load(open('/tmp/bench_window.json'))
+json.dump(d, open('$out', 'w'), indent=2)
+print('wrote $out')" && echo "[$(stamp)] bench OK"
+else
+  echo "[$(stamp)] bench FAILED"; tail -3 /tmp/bench_window.err
+fi
+
+echo "[$(stamp)] == 3/5 tpu_smoke =="
+bash scripts/tpu_smoke.sh && echo "[$(stamp)] smoke OK" \
+  || echo "[$(stamp)] smoke FAILED"
+
+echo "[$(stamp)] == 4/5 profile_north =="
+if python scripts/profile_north.py > /tmp/profile_north.json \
+     2>/tmp/profile_north.err; then
+  cp /tmp/profile_north.json docs/PROFILE_NORTH.json
+  cat docs/PROFILE_NORTH.json; echo "[$(stamp)] profile OK"
+else
+  echo "[$(stamp)] profile FAILED"; tail -3 /tmp/profile_north.err
+fi
+
+echo "[$(stamp)] == 5/5 tpu_demo =="
+bash scripts/tpu_demo.sh && echo "[$(stamp)] demo OK" \
+  || echo "[$(stamp)] demo FAILED"
+echo "[$(stamp)] agenda complete — inspect artifacts and commit"
