@@ -57,6 +57,34 @@ NUM_LANES = 128
 NUM_SUBLANES = 8
 
 
+def _masked_scores(q_tile, k_tile, *, scale, rows, cols, qm, km, causal,
+                   seq_len, block_k):
+    """(scores, live) with the shared two-fill semantics: pad pairs get the
+    finite FILL (``live`` marks the untouched entries — ds must be zeroed
+    where not live), causal/ragged bounds get -inf. One definition for the
+    forward and both backward kernels so the masking cannot drift."""
+    s = jax.lax.dot_general(q_tile, k_tile, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    live = None
+    if km is not None:
+        live = km & qm
+        s = jnp.where(live, s, FILL)
+    if causal:
+        s = jnp.where(cols <= rows, s, -jnp.inf)
+    if seq_len % block_k:                     # ragged tail tile bounds
+        s = jnp.where(cols < seq_len, s, -jnp.inf)
+    return s, live
+
+
+def _mask_views(mask_in, b, n):
+    """(mq, mk): the (b, n) int mask as lane-broadcast (b, n, 128) for
+    query-row views and sublane-broadcast (b, 8, n) for key-column views —
+    the Mosaic-legal layouts every kernel slices 2-D tiles from."""
+    mq = jnp.broadcast_to(mask_in[:, :, None], (b, n, NUM_LANES))
+    mk = jnp.broadcast_to(mask_in[:, None, :], (b, NUM_SUBLANES, n))
+    return mq, mk
+
+
 def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
                 block_k: int, seq_len: int, has_mask: bool):
     if has_mask:
@@ -82,17 +110,12 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         m, l, acc = carry
         kb = k_ref[0, pl.ds(ik * block_k, block_k), :]
         vb = v_ref[0, pl.ds(ik * block_k, block_k), :]
-        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if has_mask:
-            km = mk_ref[0, :1, pl.ds(ik * block_k, block_k)] != 0  # (1, BK)
-            pad_ok = km & qm
-            s = jnp.where(pad_ok, s, FILL)
-        cols = ik * block_k + cols_base
-        if causal:
-            s = jnp.where(cols <= rows, s, -jnp.inf)
-        if seq_len % block_k:                 # ragged tail tile bounds
-            s = jnp.where(cols < seq_len, s, -jnp.inf)
+        km = (mk_ref[0, :1, pl.ds(ik * block_k, block_k)] != 0) \
+            if has_mask else None
+        s, _ = _masked_scores(q, kb, scale=scale, rows=rows,
+                              cols=ik * block_k + cols_base, qm=qm, km=km,
+                              causal=causal, seq_len=seq_len,
+                              block_k=block_k)
 
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -148,11 +171,9 @@ def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k, interpret):
     in_specs = []
     inputs = []
     if has_mask:
-        mask_in = _pad_seq(mask, mult, 1).astype(jnp.int32)  # (b, n)
         # q-side: broadcast over lanes; k-side: broadcast over sublanes —
         # gives the kernel 2-D (BQ, 1) / (1, BK) views with no transposes.
-        mq = jnp.broadcast_to(mask_in[:, :, None], (b, n, NUM_LANES))
-        mk = jnp.broadcast_to(mask_in[:, None, :], (b, NUM_SUBLANES, n))
+        mq, mk = _mask_views(_pad_seq(mask, mult, 1).astype(jnp.int32), b, n)
         in_specs += [
             pl.BlockSpec((1, block_q, NUM_LANES),
                          lambda ib, iq: (ib // h, iq, 0)),
@@ -285,25 +306,242 @@ def blockwise_attention_bwd(q, k, v, mask, dout, out, softmax_stats, *,
 
 
 # ---------------------------------------------------------------------------
+# Pallas backward kernels (opt-in: flash_attention(bwd_impl="pallas"))
+#
+# Same recomputation math as blockwise_attention_bwd, but as two
+# pallas_calls so (1) causal-dead tiles are SKIPPED (the XLA scan walks
+# every (row, key-tile) pair and masks — ~2x waste on causal attention)
+# and (2) the (n, block) probability/ds intermediates live in VMEM instead
+# of round-tripping HBM. dq is gridded over query tiles (loop over key
+# tiles <= diagonal); dk/dv are gridded over key tiles (loop over query
+# tiles >= diagonal). Masking mirrors the forward exactly (pad FILL with
+# zeroed ds, causal -inf, ragged bound).
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, seq_len,
+                   has_mask):
+    if has_mask:
+        (mq_ref, mk_ref, q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
+         dq_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref, dq_ref = refs
+    iq = pl.program_id(1)
+    q = q_ref[0]                                           # (BQ, d)
+    do = do_ref[0]                                         # (BQ, d)
+    m = m_ref[0][:, :1]                                    # (BQ, 1) f32
+    inv_l = 1.0 / l_ref[0][:, :1]
+    dstat = d_ref[0][:, :1]
+    rows = iq * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    qm = (mq_ref[0][:, :1] != 0) if has_mask else None
+
+    num_k = pl.cdiv(seq_len, block_k)
+    if causal:
+        num_k = jnp.minimum(num_k, pl.cdiv((iq + 1) * block_q, block_k))
+
+    def body(ik, dq):
+        kb = k_ref[0, pl.ds(ik * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(ik * block_k, block_k), :]
+        km = (mk_ref[0, :1, pl.ds(ik * block_k, block_k)] != 0) \
+            if has_mask else None
+        s, live = _masked_scores(q, kb, scale=scale, rows=rows,
+                                 cols=ik * block_k + cols_base, qm=qm,
+                                 km=km, causal=causal, seq_len=seq_len,
+                                 block_k=block_k)
+        p = jnp.exp(s - m) * inv_l
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dstat) * scale
+        if live is not None:
+            ds = jnp.where(live, ds, 0.0)
+        return dq + jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    dq_ref[0] = lax.fori_loop(0, num_k, body, dq0).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_len,
+                    has_mask):
+    if has_mask:
+        (mq_ref, mk_ref, q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
+         dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
+         dk_ref, dv_ref) = refs
+    ik = pl.program_id(1)
+    kb = k_ref[0]                                          # (BK, d)
+    vb = v_ref[0]                                          # (BK, d)
+    cols = ik * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    rows_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    km = (mk_ref[0, :1, pl.ds(ik * block_k, block_k)] != 0) if has_mask \
+        else None
+
+    num_q = pl.cdiv(seq_len, block_q)
+    # causal: query tiles strictly before this key tile see none of it
+    iq0 = (ik * block_k) // block_q if causal else 0
+
+    def body(iq, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(iq * block_q, block_q), :]
+        do = do_ref[0, pl.ds(iq * block_q, block_q), :]
+        m = m_ref[0, pl.ds(iq * block_q, block_q), :1]
+        inv_l = 1.0 / l_ref[0, pl.ds(iq * block_q, block_q), :1]
+        dstat = d_ref[0, pl.ds(iq * block_q, block_q), :1]
+        qm = (mq_ref[0, pl.ds(iq * block_q, block_q), :1] != 0) \
+            if has_mask else None
+        s, live = _masked_scores(qb, kb, scale=scale,
+                                 rows=iq * block_q + rows_base, cols=cols,
+                                 qm=qm, km=km, causal=causal,
+                                 seq_len=seq_len, block_k=block_k)
+        p = jnp.exp(s - m) * inv_l
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dstat) * scale
+        if live is not None:
+            ds = jnp.where(live, ds, 0.0)
+        dk = dk + jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, q_ref.shape[-1]), jnp.float32)
+    dv0 = jnp.zeros((block_k, q_ref.shape[-1]), jnp.float32)
+    dk, dv = lax.fori_loop(iq0, num_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pallas_attention_bwd(q, k, v, mask, dout, out, softmax_stats, *,
+                          scale, causal, block_q, block_k, interpret):
+    """Pallas counterpart of ``blockwise_attention_bwd`` (dense/causal/pad
+    only — the sparse layout keeps the XLA blockwise path)."""
+    m_stat, l_stat = softmax_stats
+    b, h, n_orig, d = q.shape
+    mult = max(block_q, block_k)
+    q, k, v, dout, out = (_pad_seq(x, mult, 2)
+                          for x in (q, k, v, dout, out))
+    m_stat = _pad_seq(m_stat, mult, 2)
+    l_stat = _pad_seq(l_stat, mult, 2)
+    if l_stat.shape[-1] != n_orig:                  # keep 1/l finite on pad
+        l_stat = jnp.where(jnp.arange(l_stat.shape[-1]) < n_orig,
+                           l_stat, 1.0)
+    b, h, n, d = q.shape
+    bh = b * h
+    has_mask = mask is not None
+    D = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1)                                        # (b, h, n)
+
+    def lanes(x):                     # (b, h, n) -> (bh, n, NUM_LANES) f32
+        return jnp.broadcast_to(x.astype(jnp.float32).reshape(bh, n)[
+            :, :, None], (bh, n, NUM_LANES))
+
+    stats = [lanes(m_stat), lanes(l_stat), lanes(D)]
+    qf, kf, vf, dof = (x.reshape(bh, n, d) for x in (q, k, v, dout))
+
+    mask_inputs, mk_spec = [], None
+    if has_mask:
+        mask_inputs = list(_mask_views(
+            _pad_seq(mask, mult, 1).astype(jnp.int32), b, n))
+        mk_spec = pl.BlockSpec((1, NUM_SUBLANES, n),
+                               lambda ib, i: (ib // h, 0, 0))
+
+    full = lambda ib, i: (ib, 0, 0)                    # noqa: E731
+    tile_q = lambda ib, i: (ib, i, 0)                  # noqa: E731
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, seq_len=n_orig, has_mask=has_mask)
+
+    # dq: grid over query tiles
+    in_specs = []
+    if has_mask:
+        in_specs += [pl.BlockSpec((1, block_q, NUM_LANES),
+                                  lambda ib, i: (ib // h, i, 0)), mk_spec]
+    in_specs += [
+        pl.BlockSpec((1, block_q, d), tile_q),         # q tile
+        pl.BlockSpec((1, n, d), full),                 # k full
+        pl.BlockSpec((1, n, d), full),                 # v full
+        pl.BlockSpec((1, block_q, d), tile_q),         # dout tile
+        pl.BlockSpec((1, block_q, NUM_LANES), tile_q),  # m
+        pl.BlockSpec((1, block_q, NUM_LANES), tile_q),  # l
+        pl.BlockSpec((1, block_q, NUM_LANES), tile_q),  # D
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(bh, pl.cdiv(n, block_q)),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), tile_q),
+        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        interpret=interpret,
+    )(*mask_inputs, qf, kf, vf, dof, *stats)
+
+    # dk/dv: grid over key tiles
+    tile_k = lambda ib, i: (ib, i, 0)                  # noqa: E731
+    in_specs = []
+    if has_mask:
+        in_specs += [pl.BlockSpec((1, n, NUM_LANES),
+                                  lambda ib, i: (ib // h, 0, 0)), mk_spec]
+    in_specs += [
+        pl.BlockSpec((1, n, d), full),                 # q full
+        pl.BlockSpec((1, block_k, d), tile_k),         # k tile
+        pl.BlockSpec((1, block_k, d), tile_k),         # v tile
+        pl.BlockSpec((1, n, d), full),                 # dout full
+        pl.BlockSpec((1, n, NUM_LANES), full),         # m
+        pl.BlockSpec((1, n, NUM_LANES), full),         # l
+        pl.BlockSpec((1, n, NUM_LANES), full),         # D
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(bh, pl.cdiv(n, block_k)),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, block_k, d), tile_k),
+                   pl.BlockSpec((1, block_k, d), tile_k)],
+        out_shape=[jax.ShapeDtypeStruct((bh, n, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, n, d), v.dtype)],
+        interpret=interpret,
+    )(*mask_inputs, qf, kf, vf, dof, *stats)
+
+    dq = dq.reshape(b, h, n, d)[:, :, :n_orig]
+    dk = dk.reshape(b, h, n, d)[:, :, :n_orig]
+    dv = dv.reshape(b, h, n, d)[:, :, :n_orig]
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
 # custom_vjp plumbing + public entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, mask, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, mask, scale, causal, block_q, block_k, interpret,
+           bwd_impl):
     out, _ = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k,
                         interpret)
     return out
 
 
 def _flash_fwd_rule(q, k, v, mask, scale, causal, block_q, block_k,
-                    interpret):
+                    interpret, bwd_impl):
     out, stats = _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k,
                             interpret)
     return out, (q, k, v, mask, out, stats)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, dout):
+def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, bwd_impl,
+                    res, dout):
     q, k, v, mask, out, stats = res
+
+    if bwd_impl == "pallas":
+        dq, dk, dv = _pallas_attention_bwd(
+            q, k, v, mask, dout, out, stats, scale=scale, causal=causal,
+            block_q=min(block_q, q.shape[2]),
+            block_k=min(block_k, q.shape[2]), interpret=interpret)
+        return dq, dk, dv, None
 
     def structural(rows, cols):
         if not causal:
@@ -323,17 +561,23 @@ def flash_attention(q: Array, k: Array, v: Array, *,
                     scale: Optional[float] = None, causal: bool = True,
                     mask: Optional[Array] = None, block_q: int = 128,
                     block_k: int = 128,
-                    interpret: Optional[bool] = None) -> Array:
+                    interpret: Optional[bool] = None,
+                    bwd_impl: str = "xla") -> Array:
     """Exact attention, Pallas forward + blockwise custom_vjp backward.
 
     q/k/v: (b, h, n, d); mask: (b, n) True=keep. ``interpret=None``
     auto-selects the Pallas interpreter off-TPU so the same code path runs
-    on the CPU test mesh.
+    on the CPU test mesh. ``bwd_impl='pallas'`` swaps the XLA blockwise
+    backward for the Pallas kernels (causal-dead tiles skipped, VMEM
+    intermediates) — opt-in until compiled-mode numbers are recorded.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if bwd_impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
     n = q.shape[2]
     return _flash(q, k, v, mask, float(scale), bool(causal),
-                  min(block_q, n), min(block_k, n), bool(interpret))
+                  min(block_q, n), min(block_k, n), bool(interpret),
+                  bwd_impl)

@@ -113,13 +113,14 @@ def main():
     def fetch(x):
         return _fetch(x if isinstance(x, jax.Array) else jax.tree.leaves(x)[0])
 
-    # -- attention fwd+bwd, both impls, one layer x depth ------------------
+    # -- attention fwd+bwd, all impls, one layer x depth -------------------
     x = jax.random.normal(key, (b, h_dim, n, dh), dt)
-    for impl in ("flash", "xla"):
-        if impl == "flash":
+    for impl in ("flash", "flash_pallas_bwd", "xla"):
+        if impl.startswith("flash"):
             from dalle_pytorch_tpu.ops.flash_attention import flash_attention
-            att = functools.partial(flash_attention, causal=True,
-                                    scale=d ** -0.5)
+            att = functools.partial(
+                flash_attention, causal=True, scale=d ** -0.5,
+                bwd_impl="pallas" if impl.endswith("pallas_bwd") else "xla")
         else:
             def att(q, k, v):
                 w = attn_ops.dense_attention_weights(q, k, d ** -0.5, None,
