@@ -81,6 +81,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="alternate sparse/dense attention layers")
     p.add_argument("--attn_impl", type=str, default="xla",
                    choices=["xla", "flash"])
+    p.add_argument("--attn_bwd_impl", type=str, default="xla",
+                   choices=["xla", "pallas"],
+                   help="flash backward: XLA blockwise scan or the Pallas "
+                        "kernels (causal tile skipping)")
     p.add_argument("--sparse_impl", type=str, default="windowed",
                    choices=["ref", "windowed", "pallas"],
                    help="'windowed' is the exact fast path (block-diagonal "
@@ -130,6 +134,7 @@ def main(argv=None):
         dim_head=args.dim_head, reversible=args.reversible,
         attn_dropout=args.attn_dropout, ff_dropout=args.ff_dropout,
         sparse_attn=sparse, attn_impl=args.attn_impl,
+        attn_bwd_impl=args.attn_bwd_impl,
         sparse_impl=args.sparse_impl, loss_chunk=args.loss_chunk)
 
     key = jax.random.PRNGKey(args.seed)

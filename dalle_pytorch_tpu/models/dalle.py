@@ -59,6 +59,7 @@ class DALLEConfig:
     sparse_attn: Union[bool, Tuple[bool, ...]] = False
     sparse_block: int = 16
     attn_impl: str = "xla"
+    attn_bwd_impl: str = "xla"   # flash backward: 'xla' | 'pallas' kernels
     sparse_impl: str = "ref"
     scale_mode: str = "dim"     # reference transformer.py:57 uses dim**-0.5
     remat: str = "none"
@@ -101,6 +102,7 @@ class DALLEConfig:
             attn_dropout=self.attn_dropout, ff_dropout=self.ff_dropout,
             reversible=self.reversible, sparse_attn=self.sparse_attn,
             sparse_block=self.sparse_block, attn_impl=self.attn_impl,
+            attn_bwd_impl=self.attn_bwd_impl,
             sparse_impl=self.sparse_impl, scale_mode=self.scale_mode,
             remat=self.remat)
 

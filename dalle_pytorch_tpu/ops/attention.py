@@ -118,8 +118,11 @@ def attention_apply(params: dict, x: Array, *, heads: int, dim_head: int,
                     dropout_rate: float = 0.0,
                     dropout_key: Optional[Array] = None,
                     train: bool = False,
-                    impl: str = "xla") -> Array:
-    """Full attention block: qkv proj -> attention -> out proj (+dropout)."""
+                    impl: str = "xla",
+                    bwd_impl: str = "xla") -> Array:
+    """Full attention block: qkv proj -> attention -> out proj (+dropout).
+    ``bwd_impl`` selects the flash backward ('xla' blockwise | 'pallas'
+    kernels); ignored on the xla forward path."""
     if impl not in ("xla", "flash"):
         raise ValueError(f"unknown attention impl {impl!r}; "
                          f"expected 'xla' or 'flash'")
@@ -127,7 +130,8 @@ def attention_apply(params: dict, x: Array, *, heads: int, dim_head: int,
 
     if impl == "flash":
         from dalle_pytorch_tpu.ops.flash_attention import flash_attention
-        out = flash_attention(q, k, v, scale=scale, causal=causal, mask=mask)
+        out = flash_attention(q, k, v, scale=scale, causal=causal, mask=mask,
+                              bwd_impl=bwd_impl)
     else:
         attn = dense_attention_weights(q, k, scale, mask, causal)
         out = jnp.einsum("bhij,bhjd->bhid", attn, v)

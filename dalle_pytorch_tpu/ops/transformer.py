@@ -52,7 +52,10 @@ class TransformerConfig:
     sparse_attn: Union[bool, Tuple[bool, ...]] = False
     sparse_block: int = 16
     attn_impl: str = "xla"      # 'xla' | 'flash'
-    sparse_impl: str = "ref"    # 'ref' | 'pallas'
+    # flash backward: 'xla' blockwise scan | 'pallas' kernels (causal tile
+    # skipping); only meaningful with attn_impl='flash'
+    attn_bwd_impl: str = "xla"
+    sparse_impl: str = "ref"    # 'ref' | 'windowed' | 'pallas'
     # reference uses dim**-0.5 (transformer.py:57); 'head' gives dim_head**-0.5
     scale_mode: str = "dim"
     remat: str = "none"          # 'none' | 'full'
@@ -114,7 +117,8 @@ def attn_branch(layer_params: dict, x: Array, mask: Optional[Array],
     dense_kwargs = dict(heads=cfg.heads, dim_head=cfg.dim_head,
                         scale=cfg.scale, causal=cfg.causal, mask=mask,
                         dropout_rate=cfg.attn_dropout, dropout_key=key,
-                        train=train, impl=cfg.attn_impl)
+                        train=train, impl=cfg.attn_impl,
+                        bwd_impl=cfg.attn_bwd_impl)
 
     pattern = cfg.sparse_pattern
     if not any(pattern):

@@ -43,8 +43,8 @@ done
 echo "[$(stamp)] tunnel healthy — running the agenda"
 
 echo "[$(stamp)] == 1/5 tune_north =="
-python scripts/tune_north.py --attns xla,flash --batches 16,32,64 \
-  --loss_chunks 0,256 --claim_retries 2 \
+python scripts/tune_north.py --attns xla,flash,flash_pallas \
+  --batches 16,32,64 --loss_chunks 0,256 --claim_retries 2 \
   && echo "[$(stamp)] tune OK" || echo "[$(stamp)] tune FAILED"
 
 echo "[$(stamp)] == 2/5 full bench =="
