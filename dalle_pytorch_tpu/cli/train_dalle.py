@@ -89,6 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["ref", "windowed", "pallas"],
                    help="'windowed' is the exact fast path (block-diagonal "
                         "+ global strip, ~16x fewer FLOPs at seq 1280)")
+    p.add_argument("--moe_experts", type=int, default=0,
+                   help="replace every FF with a top-k MoE of this many "
+                        "experts (0 = plain GEGLU; beyond-reference)")
+    p.add_argument("--moe_k", type=int, default=2)
     p.add_argument("--grad_accum", type=int, default=1,
                    help="accumulate gradients over this many microbatches "
                         "per optimizer step (batchSize must divide)")
@@ -135,6 +139,7 @@ def main(argv=None):
         attn_dropout=args.attn_dropout, ff_dropout=args.ff_dropout,
         sparse_attn=sparse, attn_impl=args.attn_impl,
         attn_bwd_impl=args.attn_bwd_impl,
+        moe_experts=args.moe_experts, moe_k=args.moe_k,
         sparse_impl=args.sparse_impl, loss_chunk=args.loss_chunk)
 
     key = jax.random.PRNGKey(args.seed)

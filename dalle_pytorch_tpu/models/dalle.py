@@ -61,6 +61,12 @@ class DALLEConfig:
     attn_impl: str = "xla"
     attn_bwd_impl: str = "xla"   # flash backward: 'xla' | 'pallas' kernels
     sparse_impl: str = "ref"
+    # MoE FF (beyond reference): 0 = plain GEGLU; >0 experts per layer,
+    # expert axis shardable over 'ep'. aux coef weights the Switch
+    # load-balance loss into the training objective.
+    moe_experts: int = 0
+    moe_k: int = 2
+    moe_aux_coef: float = 1e-2
     scale_mode: str = "dim"     # reference transformer.py:57 uses dim**-0.5
     remat: str = "none"
     # 'grid' factorizes over the token grid; 'full_image' reproduces the
@@ -104,7 +110,8 @@ class DALLEConfig:
             sparse_block=self.sparse_block, attn_impl=self.attn_impl,
             attn_bwd_impl=self.attn_bwd_impl,
             sparse_impl=self.sparse_impl, scale_mode=self.scale_mode,
-            remat=self.remat)
+            remat=self.remat, moe_experts=self.moe_experts,
+            moe_k=self.moe_k)
 
 
 # ---------------------------------------------------------------------------
@@ -239,9 +246,9 @@ def dalle_apply(params: dict, text: Array, image=None, *, cfg: DALLEConfig,
         pad = jnp.ones((mask.shape[0], image_ids.shape[1]), bool)
         mask = jnp.concatenate([mask, pad], axis=1)
 
-    h = T.transformer_apply(params["transformer"], tokens,
-                            cfg=cfg.transformer, mask=mask, rng=rng,
-                            train=train)
+    h, aux = T.transformer_apply(params["transformer"], tokens,
+                                 cfg=cfg.transformer, mask=mask, rng=rng,
+                                 train=train, with_aux=True)
 
     if not return_loss:
         logits = to_logits(params, h)
@@ -250,7 +257,10 @@ def dalle_apply(params: dict, text: Array, image=None, *, cfg: DALLEConfig,
 
     if image_ids is None:
         raise ValueError("when training, image must be supplied")
-    return ce_from_hidden(params, h, text, image_ids, cfg=cfg)
+    loss = ce_from_hidden(params, h, text, image_ids, cfg=cfg)
+    if cfg.moe_experts:
+        loss = loss + cfg.moe_aux_coef * aux
+    return loss
 
 
 def ce_from_hidden(params: dict, h: Array, text: Array, image_ids: Array, *,

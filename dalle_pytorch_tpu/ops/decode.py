@@ -115,12 +115,12 @@ def prefill(params: dict, x: Array, *, cfg, total_len: int,
             x1, x2 = carry
             a, k, v = _attn_with_kv(lp, x2, allowed, cfg)
             y1 = x1 + a
-            y2 = x2 + T.ff_branch(lp, y1, cfg, None, False)
+            y2 = x2 + T.ff_or_moe(lp, y1, cfg, None, False)[0]
             return (y1, y2), (k, v)
         h = carry
         a, k, v = _attn_with_kv(lp, h, allowed, cfg)
         h = h + a
-        h = h + T.ff_branch(lp, h, cfg, None, False)
+        h = h + T.ff_or_moe(lp, h, cfg, None, False)[0]
         return h, (k, v)
 
     carry0 = (x, x) if cfg.reversible else x
@@ -181,12 +181,12 @@ def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,
             x1, x2 = carry
             a, k, v = attn_cached(lp, x2, ck, cv, is_sparse)
             y1 = x1 + a
-            y2 = x2 + T.ff_branch(lp, y1, cfg, None, False)
+            y2 = x2 + T.ff_or_moe(lp, y1, cfg, None, False)[0]
             return (y1, y2), (k, v)
         h = carry
         a, k, v = attn_cached(lp, h, ck, cv, is_sparse)
         h = h + a
-        h = h + T.ff_branch(lp, h, cfg, None, False)
+        h = h + T.ff_or_moe(lp, h, cfg, None, False)[0]
         return h, (k, v)
 
     carry0 = (h_in, h_in) if cfg.reversible else h_in

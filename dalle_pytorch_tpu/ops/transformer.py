@@ -59,6 +59,19 @@ class TransformerConfig:
     # reference uses dim**-0.5 (transformer.py:57); 'head' gives dim_head**-0.5
     scale_mode: str = "dim"
     remat: str = "none"          # 'none' | 'full'
+    # Mixture-of-Experts FF (beyond reference — SURVEY.md §2b lists EP/MoE
+    # absent): 0 = plain GEGLU; >0 replaces every FF with a top-k MoE of
+    # that many experts (ops.moe), expert axis shardable over 'ep'
+    moe_experts: int = 0
+    moe_k: int = 2
+    moe_capacity: float = 1.25
+
+    @property
+    def moe(self):
+        from dalle_pytorch_tpu.ops.moe import MoEConfig
+        return MoEConfig(dim=self.dim, num_experts=self.moe_experts,
+                         k=self.moe_k, ff_mult=self.ff_mult,
+                         capacity_factor=self.moe_capacity)
 
     @property
     def sparse_pattern(self) -> Tuple[bool, ...]:
@@ -80,17 +93,23 @@ class TransformerConfig:
 def layer_init(key: Array, cfg: TransformerConfig, dtype=jnp.float32) -> dict:
     k_attn, k_ff1, k_ff2 = jax.random.split(key, 3)
     hidden = cfg.dim * cfg.ff_mult
+    if cfg.moe_experts:
+        from dalle_pytorch_tpu.ops.moe import moe_init
+        ff = {"ln": core.layernorm_init(cfg.dim, dtype),
+              "moe": moe_init(k_ff1, cfg.moe, dtype)}
+    else:
+        ff = {
+            "ln": core.layernorm_init(cfg.dim, dtype),
+            "w1": core.linear_init(k_ff1, cfg.dim, hidden * 2, dtype=dtype),
+            "w2": core.linear_init(k_ff2, hidden, cfg.dim, dtype=dtype),
+        }
     return {
         "attn": {
             "ln": core.layernorm_init(cfg.dim, dtype),
             **attn_ops.attention_init(k_attn, cfg.dim, cfg.heads, cfg.dim_head,
                                       dtype),
         },
-        "ff": {
-            "ln": core.layernorm_init(cfg.dim, dtype),
-            "w1": core.linear_init(k_ff1, cfg.dim, hidden * 2, dtype=dtype),
-            "w2": core.linear_init(k_ff2, hidden, cfg.dim, dtype=dtype),
-        },
+        "ff": ff,
     }
 
 
@@ -184,6 +203,20 @@ def ff_branch(layer_params: dict, x: Array, cfg: TransformerConfig,
     return core.linear(p["w2"], h)
 
 
+def ff_or_moe(layer_params: dict, x: Array, cfg: TransformerConfig,
+              key: Optional[Array], train: bool) -> Tuple[Array, Array]:
+    """FF residual branch -> (out, aux). Plain GEGLU returns aux = 0; the
+    MoE variant returns its load-balance loss (the scan accumulates it)."""
+    if cfg.moe_experts:
+        from dalle_pytorch_tpu.ops.moe import moe_apply
+        p = layer_params["ff"]
+        h = core.layernorm(p["ln"], x)
+        out, aux = moe_apply(p["moe"], h, cfg=cfg.moe)
+        return core.dropout(key, out, cfg.ff_dropout, train), aux
+    return (ff_branch(layer_params, x, cfg, key, train),
+            jnp.float32(0.0))
+
+
 # ---------------------------------------------------------------------------
 # apply
 # ---------------------------------------------------------------------------
@@ -233,21 +266,30 @@ def _layer_keys(rng: Optional[Array], depth: int) -> Array:
 def transformer_apply(params: dict, x: Array, *, cfg: TransformerConfig,
                       mask: Optional[Array] = None,
                       rng: Optional[Array] = None,
-                      train: bool = False) -> Array:
-    """Run the stack. x: (b, n, dim); mask: (b, n) bool (True = keep)."""
+                      train: bool = False,
+                      with_aux: bool = False):
+    """Run the stack. x: (b, n, dim); mask: (b, n) bool (True = keep).
+    ``with_aux=True`` returns (x, aux) where aux is the summed MoE
+    load-balance loss over the depth (0.0 for plain GEGLU stacks)."""
     if train and rng is None and (cfg.attn_dropout > 0 or cfg.ff_dropout > 0):
         raise ValueError(
             "transformer_apply(train=True) with nonzero dropout requires an "
             "explicit `rng` key — JAX has no global RNG state to fall back on")
 
     if cfg.reversible:
+        if cfg.moe_experts:
+            raise ValueError("reversible=True does not compose with MoE "
+                             "layers (the FF branch is not invertible-"
+                             "stream shaped); use the sequential engine")
         from dalle_pytorch_tpu.ops.reversible import reversible_apply
-        return reversible_apply(params, x, cfg=cfg, mask=mask, rng=rng,
-                                train=train)
+        out = reversible_apply(params, x, cfg=cfg, mask=mask, rng=rng,
+                               train=train)
+        return (out, jnp.float32(0.0)) if with_aux else out
 
     keys = _layer_keys(rng, cfg.depth)
     pattern = cfg.sparse_pattern
     layout = unrolled_layout(params, keys, pattern)
+    aux0 = jnp.float32(0.0)
 
     if layout is not None:
         # Periodic dense/sparse patterns (the reference's (True, False)*32,
@@ -260,30 +302,32 @@ def transformer_apply(params: dict, x: Array, *, cfg: TransformerConfig,
 
         def body(carry, xs):
             lp, lkeys = xs
-            h = carry
+            h, aux = carry
             for i, is_sparse in enumerate(period_pat):
                 lpi = jax.tree.map(lambda a: a[i], lp)
                 h = h + attn_branch(lpi, h, mask, cfg, bool(is_sparse),
                                     lkeys[i][0], train)
-                h = h + ff_branch(lpi, h, cfg, lkeys[i][1], train)
-            return h, None
+                f, a = ff_or_moe(lpi, h, cfg, lkeys[i][1], train)
+                h = h + f
+                aux = aux + a
+            return (h, aux), None
 
         if cfg.remat == "full":
             body = jax.checkpoint(body)
-        out, _ = lax.scan(body, x, (stacked, keys_r))
-        return out
+        (out, aux), _ = lax.scan(body, (x, aux0), (stacked, keys_r))
+        return (out, aux) if with_aux else out
 
     sparse_flags = jnp.asarray(pattern)
 
     def body(carry, xs):
         lp, lkeys, is_sparse = xs
-        h = carry
+        h, aux = carry
         h = h + attn_branch(lp, h, mask, cfg, is_sparse, lkeys[0], train)
-        h = h + ff_branch(lp, h, cfg, lkeys[1], train)
-        return h, None
+        f, a = ff_or_moe(lp, h, cfg, lkeys[1], train)
+        return (h + f, aux + a), None
 
     if cfg.remat == "full":
         body = jax.checkpoint(body)
 
-    out, _ = lax.scan(body, x, (params, keys, sparse_flags))
-    return out
+    (out, aux), _ = lax.scan(body, (x, aux0), (params, keys, sparse_flags))
+    return (out, aux) if with_aux else out

@@ -90,6 +90,10 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
         # function; pp + reversible is a future combination
         raise NotImplementedError(
             "pipeline_transformer does not support reversible=True")
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "pipeline_transformer does not support MoE layers (the aux "
+            "loss is not threaded through the tick scan)")
     dropout_on = train and (cfg.attn_dropout > 0 or cfg.ff_dropout > 0)
     if dropout_on and rng is None:
         raise ValueError(

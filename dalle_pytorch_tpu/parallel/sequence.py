@@ -57,6 +57,9 @@ def _check_cfg(cfg: T.TransformerConfig) -> None:
     if cfg.reversible:
         raise ValueError("sequence parallelism and reversible execution "
                          "are mutually exclusive engines")
+    if cfg.moe_experts:
+        raise ValueError("sequence parallelism does not yet compose with "
+                         "MoE layers (route tokens before sharding them)")
 
 
 def sp_transformer_apply(params, x, *, cfg: T.TransformerConfig, mesh: Mesh,

@@ -200,6 +200,19 @@ def dalle_param_specs(params, tp: Optional[str] = None,
     return jax.tree_util.tree_map_with_path(checked, params)
 
 
+def dalle_moe_param_specs(params, axis: str = "ep"):
+    """PartitionSpecs sharding the MoE expert axis over ``axis``: the
+    depth-stacked expert weights (depth, E, ...) get P(None, axis); the
+    router and everything else replicate. Feed to
+    ``setup_sharded(param_specs=...)`` on a dp x ep mesh — GSPMD inserts
+    the token->expert collectives."""
+    specs = jax.tree.map(lambda _: P(), params)
+    moe = specs["transformer"]["ff"]["moe"]
+    moe["w1"] = P(None, axis)
+    moe["w2"] = P(None, axis)
+    return specs
+
+
 # ---------------------------------------------------------------------------
 # model-specific loss closures
 # ---------------------------------------------------------------------------
