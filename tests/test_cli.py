@@ -290,6 +290,30 @@ class TestTrainDALLESequenceParallel:
         assert epoch == 0
 
 
+class TestTrainDALLEMoE:
+    def test_moe_train_runs_and_checkpoints(self, workdir):
+        """--moe_experts 4: the MoE FF trains end-to-end through the CLI
+        (aux loss in the objective) and checkpoints."""
+        require_ckpt(workdir, "vae", 2)
+        from dalle_pytorch_tpu.cli.train_dalle import main
+        main([
+            "--dataPath", str(workdir / "imagedata"),
+            "--imageSize", str(IMG), "--batchSize", "8",
+            "--captions_only", str(workdir / "only.txt"),
+            "--captions", str(workdir / "pairs.txt"),
+            "--vaename", "vae", "--vae_epoch", "2",
+            "--name", "moetoy", "--n_epochs", "1",
+            "--dim", "16", "--depth", "2", "--heads", "4",
+            "--dim_head", "4", "--num_text_tokens", "50",
+            "--text_seq_len", "8", "--moe_experts", "4",
+            "--lr", "1e-3", "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+            "--log_interval", "1", "--sample_every", "100",
+        ])
+        path, epoch = ckpt.latest(str(workdir / "models"), "moetoy_dalle")
+        assert epoch == 0
+
+
 class TestTrainDALLEPipelineParallel:
     def test_pp_train_runs_and_checkpoints(self, workdir):
         """--pp 4 on the 8-device CPU mesh: dp=2 x pp=4, one layer per
