@@ -76,48 +76,52 @@ def moe_apply(params: dict, x: Array, *, cfg: MoEConfig
               ) -> Tuple[Array, Array]:
     """-> (out (b, n, d), aux load-balance loss scalar).
 
-    Exact dense-dispatch computation: tokens over capacity are DROPPED
-    from the expert (they contribute zero here; the transformer's residual
-    still carries them — Switch-style graceful overflow).
+    Exact dense-dispatch computation, GROUPED per batch row (GShard's
+    group semantics): each row routes its n tokens independently with
+    capacity C = ceil(n*k/E * cf), so the one-hot dispatch/combine
+    tensors are (b, n, E, C) — O(n^2 k cf) per row — instead of the
+    O((bn)^2) a flat global queue would cost. Tokens over a row's
+    capacity are DROPPED from the expert (they contribute zero here; the
+    transformer's residual still carries them — Switch-style graceful
+    overflow).
     """
     b, n, d = x.shape
     e, k = cfg.num_experts, cfg.k
-    t = b * n
-    xt = x.reshape(t, d)
-
-    logits = core.linear(params["router"], xt.astype(jnp.float32))
-    probs = jax.nn.softmax(logits, axis=-1)              # (T, E)
-    gate_vals, idx = lax.top_k(probs, k)                 # (T, k)
-    gate_vals = gate_vals / jnp.maximum(
-        gate_vals.sum(axis=-1, keepdims=True), 1e-9)
-
-    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)   # (T, k, E)
-    # queue position of each token within its expert (first-come order)
-    ranks = jnp.cumsum(onehot.sum(1), axis=0) - onehot.sum(1)  # (T, E)
     # floor the FINAL capacity at 1 — a 0-width queue would silently zero
     # the whole layer (every token overflows)
-    cap = max(1, int(-(-t * k // e) * cfg.capacity_factor))
-    keep = (ranks < cap)[:, None, :] * onehot            # (T, k, E)
-
-    # dispatch: binary (T, E, C); combine: gate-weighted dispatch
-    pos = jax.nn.one_hot(ranks, cap, dtype=jnp.float32)  # (T, E, C)
-    dispatch = jnp.einsum("tke,tec->tec", keep, pos)
-    combine = jnp.einsum("tke,tk,tec->tec", keep, gate_vals.astype(
-        jnp.float32), pos)
-
+    cap = max(1, int(-(-n * k // e) * cfg.capacity_factor))
     cdt = x.dtype
-    xin = jnp.einsum("tec,td->ecd", dispatch.astype(cdt), xt)   # (E, C, d)
-    h = jnp.einsum("ecd,edf->ecf", xin, params["w1"])           # (E, C, 2h)
-    h, gates = jnp.split(h, 2, axis=-1)
-    h = h * core.gelu(gates)
-    eout = jnp.einsum("ecf,efd->ecd", h, params["w2"])          # (E, C, d)
-    out = jnp.einsum("tec,ecd->td", combine.astype(cdt), eout)
 
-    # Switch load-balance loss: E * sum_e mean_prob_e * token_frac_e
-    token_frac = onehot[:, 0].mean(axis=0)               # top-1 assignment
-    mean_prob = probs.mean(axis=0)
-    aux = e * jnp.sum(token_frac * mean_prob)
-    return out.reshape(b, n, d), aux.astype(jnp.float32)
+    def group(xt):                                       # (n, d) one row
+        logits = core.linear(params["router"], xt.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)          # (n, E)
+        gate_vals, idx = lax.top_k(probs, k)             # (n, k)
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(axis=-1, keepdims=True), 1e-9)
+
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)     # (n, k, E)
+        # queue position of each token within its expert (first-come)
+        ranks = jnp.cumsum(onehot.sum(1), axis=0) - onehot.sum(1)  # (n, E)
+        keep = (ranks < cap)[:, None, :] * onehot        # (n, k, E)
+
+        # dispatch: binary (n, E, C); combine: gate-weighted dispatch
+        pos = jax.nn.one_hot(ranks, cap, dtype=jnp.float32)    # (n, E, C)
+        dispatch = jnp.einsum("tke,tec->tec", keep, pos)
+        combine = jnp.einsum("tke,tk,tec->tec", keep, gate_vals, pos)
+
+        xin = jnp.einsum("tec,td->ecd", dispatch.astype(cdt), xt)
+        h = jnp.einsum("ecd,edf->ecf", xin, params["w1"])      # (E, C, 2h)
+        h, gates = jnp.split(h, 2, axis=-1)
+        h = h * core.gelu(gates)
+        eout = jnp.einsum("ecf,efd->ecd", h, params["w2"])     # (E, C, d)
+        out = jnp.einsum("tec,ecd->td", combine.astype(cdt), eout)
+
+        # Switch load-balance loss: E * sum_e mean_prob_e * token_frac_e
+        aux = e * jnp.sum(onehot[:, 0].mean(axis=0) * probs.mean(axis=0))
+        return out, aux
+
+    out, aux = jax.vmap(group)(x)
+    return out, jnp.mean(aux).astype(jnp.float32)
 
 
 def moe_param_specs(axis: str = "ep") -> dict:
