@@ -76,6 +76,11 @@ def export_transformer(stacked: dict) -> Dict[str, np.ndarray]:
     """Depth-stacked transformer params -> per-layer reference keys
     (``layers.layers.{i}.{0,1}...``, the SequentialSequence naming)."""
     out: Dict[str, np.ndarray] = {}
+    if "moe" in stacked.get("ff", {}):
+        raise ValueError(
+            "MoE layers cannot be exported to the reference .pth format "
+            "(the reference has no MoE; its FeedForward is a single GEGLU "
+            "MLP) — train with moe_experts=0 for torch-compatible export")
     depth = jax.tree.leaves(stacked)[0].shape[0]
     for i in range(depth):
         lp = jax.tree.map(lambda a: a[i], stacked)

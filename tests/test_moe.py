@@ -174,3 +174,14 @@ def test_sp_pp_reject_moe(key):
     mesh2 = make_mesh({"pp": 2}, jax.devices()[:2])
     with pytest.raises(NotImplementedError, match="MoE"):
         pipeline_transformer(params, x, cfg=cfg, mesh=mesh2)
+
+
+def test_torch_export_rejects_moe(key):
+    from dalle_pytorch_tpu.compat.torch_export import export_transformer
+    from dalle_pytorch_tpu.ops.transformer import (TransformerConfig,
+                                                   transformer_init)
+    cfg = TransformerConfig(dim=16, depth=2, seq_len=8, heads=2, dim_head=8,
+                            moe_experts=4)
+    params = transformer_init(key, cfg)
+    with pytest.raises(ValueError, match="MoE"):
+        export_transformer(params)
