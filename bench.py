@@ -186,7 +186,11 @@ def dalle_train_flops_per_token(cfg) -> float:
 
 def build_cfg(tiny: bool, depth: int = 12, reversible: bool = False,
               sparse: bool = False, attn_impl: str = "xla",
-              loss_chunk: int = 0):
+              loss_chunk: int = 0, heads: int = 8, dim_head: int = 64):
+    """``heads``/``dim_head`` keep heads*dim_head = 512 (the north config
+    fixes dim and depth, not the head split — BASELINE.md); dim_head 128
+    fills the MXU's 128-wide contraction in attention, dim_head 64 is the
+    reference default."""
     import jax.numpy as jnp  # noqa: F401  (jax must be importable here)
     from dalle_pytorch_tpu.models import dalle as D
     from dalle_pytorch_tpu.models import vae as V
@@ -210,7 +214,8 @@ def build_cfg(tiny: bool, depth: int = 12, reversible: bool = False,
                        num_layers=3, hidden_dim=64)
     return D.DALLEConfig(
         dim=512, depth=depth, vae=vcfg, num_text_tokens=10000,
-        text_seq_len=256, reversible=reversible,
+        text_seq_len=256, reversible=reversible, heads=heads,
+        dim_head=dim_head,
         sparse_attn=(True, False) * (depth // 2) if sparse else False,
         attn_impl=attn_impl, attn_bwd_impl=attn_bwd,
         sparse_impl="pallas" if sparse else "ref",
@@ -297,7 +302,9 @@ def bench_north(args):
         attn = tuned.get("attn") or (
             "flash" if jax.default_backend() == "tpu" else "xla")
     cfg = build_cfg(args.tiny, depth=12 if not args.tiny else 2,
-                    attn_impl=attn, loss_chunk=loss_chunk)
+                    attn_impl=attn, loss_chunk=loss_chunk,
+                    heads=tuned.get("heads", 8),
+                    dim_head=tuned.get("dim_head", 64))
     note = None
     _progress(f"north: compiling train step (attn={attn}, batch={batch})")
     try:
@@ -307,10 +314,12 @@ def bench_north(args):
     except Exception as e:                    # pallas kernel failed: fall back
         if attn == "xla":
             raise
+        import dataclasses
         note = f"flash kernel failed ({type(e).__name__}), xla path"
         attn = "xla"
-        cfg = build_cfg(args.tiny, depth=12 if not args.tiny else 2,
-                        attn_impl="xla", loss_chunk=loss_chunk)
+        # same model, only the attention impl changes — keep every other
+        # tunable identical so the fallback stays comparable
+        cfg = dataclasses.replace(cfg, attn_impl="xla", attn_bwd_impl="xla")
         step, params, opt_state, data, key = setup_train(cfg, batch, mesh)
         dt, loss, params = time_steps(step, params, opt_state, data, key,
                                       args.warmup, args.steps)

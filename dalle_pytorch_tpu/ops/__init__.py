@@ -2,7 +2,11 @@
 
 This package is the L1/L3 layer of the framework: parameter init/apply pairs
 for the primitive ops (ops.core), attention in several implementations
-(ops.attention: XLA einsum reference, Pallas flash, Pallas block-sparse),
-and the transformer stack (ops.transformer) executed either sequentially via
-``lax.scan`` or reversibly via a ``jax.custom_vjp`` engine (ops.reversible).
+(ops.attention: XLA einsum reference; ops.flash_attention: Pallas flash
+fwd + opt-in Pallas bwd; ops.block_sparse: Pallas block-sparse;
+ops.sparse: dense oracle + exact windowed fast path), the top-k
+Mixture-of-Experts feed-forward (ops.moe, expert axis shardable over
+``ep``), the KV-cache decode engine (ops.decode), and the transformer
+stack (ops.transformer) executed either sequentially via ``lax.scan`` or
+reversibly via a ``jax.custom_vjp`` engine (ops.reversible).
 """

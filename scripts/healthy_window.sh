@@ -46,6 +46,13 @@ echo "[$(stamp)] == 1/5 tune_north =="
 python scripts/tune_north.py --attns xla,flash,flash_pallas \
   --batches 16,32,64 --loss_chunks 0,256 --claim_retries 2 \
   && echo "[$(stamp)] tune OK" || echo "[$(stamp)] tune FAILED"
+# follow-up: the 4x128 head split fills the MXU's 128-wide contraction in
+# attention (same 512 inner dim / same FLOPs); TUNE_NORTH.json keeps
+# whichever best wins across both sweeps
+python scripts/tune_north.py --attns flash,xla --batches 32,64 \
+  --loss_chunks 0 --head_cfgs 4x128 --claim_retries 2 \
+  && echo "[$(stamp)] head-split tune OK" \
+  || echo "[$(stamp)] head-split tune FAILED"
 
 echo "[$(stamp)] == 2/5 full bench =="
 out="docs/BENCH_TPU_$(date -u +%Y-%m-%d_%H%M).json"

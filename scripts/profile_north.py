@@ -100,7 +100,9 @@ def main():
         "flash" if jax.default_backend() == "tpu" else "xla")
     cfg = build_cfg(args.tiny, depth=12 if not args.tiny else 2,
                     attn_impl=bench_attn,
-                    loss_chunk=tuned.get("loss_chunk") or 0)
+                    loss_chunk=tuned.get("loss_chunk") or 0,
+                    heads=tuned.get("heads", 8),
+                    dim_head=tuned.get("dim_head", 64))
     batch = args.batch or (tuned.get("batch_per_chip", 8) * n_dev
                            if not args.tiny else 4)
     key = jax.random.PRNGKey(0)

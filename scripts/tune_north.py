@@ -23,6 +23,10 @@ def main():
     ap.add_argument("--batches", default="8,16,32")
     ap.add_argument("--loss_chunks", default="0",
                     help="comma list; 0 = dense CE head")
+    ap.add_argument("--head_cfgs", default="8x64",
+                    help="comma list of headsxdim_head splits of the 512 "
+                         "inner dim (e.g. '8x64,4x128'; 4x128 fills the "
+                         "MXU's 128-wide contraction)")
     ap.add_argument("--claim_retries", type=int, default=20,
                     help="re-exec for a fresh chip claim this many times "
                          "when backend init stalls/errors (wedged-tunnel "
@@ -51,11 +55,14 @@ def main():
     mesh = make_mesh({"dp": n_dev})
     peak = _bf16_peak()
     results = []
-    for attn in args.attns.split(","):
-      for chunk in (int(c) for c in args.loss_chunks.split(",")):
-        for batch in (int(b) for b in args.batches.split(",")):
+    for hc in args.head_cfgs.split(","):
+      heads, dim_head = (int(v) for v in hc.split("x"))
+      for attn in args.attns.split(","):
+        for chunk in (int(c) for c in args.loss_chunks.split(",")):
+          for batch in (int(b) for b in args.batches.split(",")):
             cfg = build_cfg(False, depth=12, attn_impl=attn,
-                            loss_chunk=chunk)
+                            loss_chunk=chunk, heads=heads,
+                            dim_head=dim_head)
             t0 = time.time()
             try:
                 step, params, opt_state, data, key = setup_train(
@@ -64,6 +71,7 @@ def main():
                                          args.warmup, args.steps)
             except Exception as e:
                 print(json.dumps({"attn": attn, "batch": batch,
+                                  "heads": heads,
                                   "error": f"{type(e).__name__}: {e}"}),
                       flush=True)
                 continue
@@ -71,6 +79,7 @@ def main():
             mfu = tps * dalle_train_flops_per_token(cfg) / peak
             rec = {"attn": attn, "batch": batch,
                    "batch_per_chip": batch // n_dev, "loss_chunk": chunk,
+                   "heads": heads, "dim_head": dim_head,
                    "tokens_sec_chip": round(tps, 1), "mfu": round(mfu, 4),
                    "loss": round(loss, 4),
                    "setup_s": round(time.time() - t0 - dt, 1)}
@@ -81,12 +90,31 @@ def main():
         best = max(results, key=lambda r: r["tokens_sec_chip"])
         print(json.dumps({"best": best}), flush=True)
         # bench.py reads this as its north-config defaults (bench_north);
-        # committing it is how a sweep's winner becomes the recorded config
+        # committing it is how a sweep's winner becomes the recorded
+        # config. Successive sweeps only ever IMPROVE the record: keep the
+        # existing best when it beats this run's.
         if jax.default_backend() == "tpu":
             out = os.path.join(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__))), "docs", "TUNE_NORTH.json")
+            def cfg_key(r):
+                return (r.get("attn"), r.get("batch"), r.get("loss_chunk"),
+                        r.get("heads", 8), r.get("dim_head", 64))
+
+            merged = {}
+            try:
+                with open(out) as f:
+                    prev = json.load(f)
+                if prev.get("backend") == "tpu":
+                    merged = {cfg_key(r): r
+                              for r in prev.get("results", [])}
+                    if (prev.get("best", {}).get("tokens_sec_chip", 0)
+                            > best["tokens_sec_chip"]):
+                        best = prev["best"]
+            except (OSError, ValueError):
+                pass
+            merged.update({cfg_key(r): r for r in results})  # latest wins
             with open(out, "w") as f:
-                json.dump({"best": best, "results": results,
+                json.dump({"best": best, "results": list(merged.values()),
                            "backend": jax.default_backend()}, f, indent=2)
             print(json.dumps({"wrote": out}), flush=True)
 
