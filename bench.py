@@ -186,14 +186,23 @@ def dalle_train_flops_per_token(cfg) -> float:
 
 def build_cfg(tiny: bool, depth: int = 12, reversible: bool = False,
               sparse: bool = False, attn_impl: str = "xla",
-              loss_chunk: int = 0, heads: int = 8, dim_head: int = 64):
+              loss_chunk: int = 0, heads: int = 8, dim_head: int = 64,
+              remat: str = "none"):
     """``heads``/``dim_head`` keep heads*dim_head = 512 (the north config
     fixes dim and depth, not the head split — BASELINE.md); dim_head 128
     fills the MXU's 128-wide contraction in attention, dim_head 64 is the
-    reference default."""
+    reference default. ``remat='full'`` checkpoints the scanned layer body
+    (jax.checkpoint): the 2026-07-31 sweep showed per-layer saved
+    activations are what cap the batch on one v5e chip (every batch>=32
+    config OOM'd at compile), so remat is the lever that buys batch."""
     import jax.numpy as jnp  # noqa: F401  (jax must be importable here)
     from dalle_pytorch_tpu.models import dalle as D
     from dalle_pytorch_tpu.models import vae as V
+
+    # the transformer only checks cfg.remat == "full"; any other string
+    # would silently run un-rematerialized under a wrong label
+    if remat not in ("none", "full"):
+        raise ValueError(f"remat must be 'none' or 'full', got {remat!r}")
 
     # 'flash_pallas' = flash forward + the Pallas backward kernels
     attn_bwd = "xla"
@@ -209,7 +218,7 @@ def build_cfg(tiny: bool, depth: int = 12, reversible: bool = False,
             sparse_attn=(True, False) if sparse else False,
             attn_impl=attn_impl, attn_bwd_impl=attn_bwd,
             sparse_impl="pallas" if sparse else "ref",
-            loss_chunk=loss_chunk)
+            loss_chunk=loss_chunk, remat=remat)
     vcfg = V.VAEConfig(image_size=256, num_tokens=2048, codebook_dim=512,
                        num_layers=3, hidden_dim=64)
     return D.DALLEConfig(
@@ -219,7 +228,7 @@ def build_cfg(tiny: bool, depth: int = 12, reversible: bool = False,
         sparse_attn=(True, False) * (depth // 2) if sparse else False,
         attn_impl=attn_impl, attn_bwd_impl=attn_bwd,
         sparse_impl="pallas" if sparse else "ref",
-        loss_chunk=loss_chunk)
+        loss_chunk=loss_chunk, remat=remat)
 
 
 def setup_train(cfg, batch, mesh):
@@ -301,10 +310,13 @@ def bench_north(args):
     if attn == "auto":
         attn = tuned.get("attn") or (
             "flash" if jax.default_backend() == "tpu" else "xla")
+    remat = args.remat
+    if remat is None:
+        remat = tuned.get("remat") or "none"
     cfg = build_cfg(args.tiny, depth=12 if not args.tiny else 2,
                     attn_impl=attn, loss_chunk=loss_chunk,
                     heads=tuned.get("heads", 8),
-                    dim_head=tuned.get("dim_head", 64))
+                    dim_head=tuned.get("dim_head", 64), remat=remat)
     note = None
     _progress(f"north: compiling train step (attn={attn}, batch={batch})")
     try:
@@ -672,6 +684,9 @@ def main():
                     help="chunked-CE head size for the north config "
                          "(0 = dense; default: the committed tuned value, "
                          "else dense)")
+    ap.add_argument("--remat", default=None, choices=["none", "full"],
+                    help="layer-body rematerialization for the north config "
+                         "(default: the committed tuned value, else none)")
     ap.add_argument("--no_gen", action="store_true",
                     help="skip the generate-latency half")
     ap.add_argument("--retries", type=int, default=3)

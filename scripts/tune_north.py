@@ -27,6 +27,11 @@ def main():
                     help="comma list of headsxdim_head splits of the 512 "
                          "inner dim (e.g. '8x64,4x128'; 4x128 fills the "
                          "MXU's 128-wide contraction)")
+    ap.add_argument("--remats", default="none",
+                    help="comma list of layer-body remat modes "
+                         "('none,full'); 'full' trades ~1/3 more FLOPs for "
+                         "per-layer activation memory, unlocking batches "
+                         "that otherwise OOM a 16G v5e chip")
     ap.add_argument("--claim_retries", type=int, default=20,
                     help="re-exec for a fresh chip claim this many times "
                          "when backend init stalls/errors (wedged-tunnel "
@@ -57,12 +62,13 @@ def main():
     results = []
     for hc in args.head_cfgs.split(","):
       heads, dim_head = (int(v) for v in hc.split("x"))
-      for attn in args.attns.split(","):
+      for remat in args.remats.split(","):
+       for attn in args.attns.split(","):
         for chunk in (int(c) for c in args.loss_chunks.split(",")):
           for batch in (int(b) for b in args.batches.split(",")):
             cfg = build_cfg(False, depth=12, attn_impl=attn,
                             loss_chunk=chunk, heads=heads,
-                            dim_head=dim_head)
+                            dim_head=dim_head, remat=remat)
             t0 = time.time()
             try:
                 step, params, opt_state, data, key = setup_train(
@@ -71,7 +77,7 @@ def main():
                                          args.warmup, args.steps)
             except Exception as e:
                 print(json.dumps({"attn": attn, "batch": batch,
-                                  "heads": heads,
+                                  "heads": heads, "remat": remat,
                                   "error": f"{type(e).__name__}: {e}"}),
                       flush=True)
                 continue
@@ -79,7 +85,7 @@ def main():
             mfu = tps * dalle_train_flops_per_token(cfg) / peak
             rec = {"attn": attn, "batch": batch,
                    "batch_per_chip": batch // n_dev, "loss_chunk": chunk,
-                   "heads": heads, "dim_head": dim_head,
+                   "heads": heads, "dim_head": dim_head, "remat": remat,
                    "tokens_sec_chip": round(tps, 1), "mfu": round(mfu, 4),
                    "loss": round(loss, 4),
                    "setup_s": round(time.time() - t0 - dt, 1)}
@@ -98,7 +104,8 @@ def main():
                 os.path.abspath(__file__))), "docs", "TUNE_NORTH.json")
             def cfg_key(r):
                 return (r.get("attn"), r.get("batch"), r.get("loss_chunk"),
-                        r.get("heads", 8), r.get("dim_head", 64))
+                        r.get("heads", 8), r.get("dim_head", 64),
+                        r.get("remat", "none"))
 
             merged = {}
             try:
