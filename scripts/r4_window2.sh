@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Round-4 second-window agenda. The 2026-07-31 00:59-01:16 window banked
+# tune + full bench + kernel smoke before the tunnel wedged again; this
+# orchestrator waits for the next healthy window and runs what that one
+# missed, highest-value first:
+#   1. remat sweep        — remat='full' unlocks batch>=32 (every such
+#                           config OOM'd un-rematerialized); also re-probes
+#                           batch 8 vs 16 on the same chip/day
+#   2. scripts/tpu_demo.sh — end-to-end trained proof (VERDICT r3 missing 2)
+#   3. scripts/profile_north.py — step decomposition (now with progress)
+#   4. python bench.py    — re-record with whatever defaults the sweep won
+# Same usage as healthy_window.sh:
+#   nohup bash scripts/r4_window2.sh > /tmp/r4_window2.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+stamp() { date -u +"%H:%M:%S"; }
+
+echo "[$(stamp)] waiting for a healthy tunnel (10-min probe deadline/try)"
+until BENCH_INIT_DEADLINE_S=${BENCH_INIT_DEADLINE_S:-600} \
+      python - <<'EOF'
+import os, sys, threading
+ok = {}
+def probe():
+    try:
+        import jax
+        ok["d"] = jax.devices()
+    except Exception:
+        pass
+t = threading.Thread(target=probe, daemon=True)
+t.start()
+t.join(float(os.environ.get("BENCH_INIT_DEADLINE_S", "600")))
+sys.stdout.flush()
+os._exit(0 if "d" in ok else 1)
+EOF
+do
+  echo "[$(stamp)] still wedged; sleeping 120s"
+  sleep 120
+done
+echo "[$(stamp)] tunnel healthy — running the window-2 agenda"
+
+echo "[$(stamp)] == 1/4 remat sweep =="
+python scripts/tune_north.py --attns flash --batches 8,16,32,64 \
+  --loss_chunks 256 --remats none,full --claim_retries 2 \
+  && echo "[$(stamp)] remat sweep OK" || echo "[$(stamp)] remat sweep FAILED"
+
+echo "[$(stamp)] == 2/4 tpu_demo =="
+bash scripts/tpu_demo.sh && echo "[$(stamp)] demo OK" \
+  || echo "[$(stamp)] demo FAILED"
+
+echo "[$(stamp)] == 3/4 profile_north =="
+if python scripts/profile_north.py > /tmp/profile_north.json \
+     2>/tmp/profile_north.err; then
+  cp /tmp/profile_north.json docs/PROFILE_NORTH.json
+  cat docs/PROFILE_NORTH.json; echo "[$(stamp)] profile OK"
+else
+  echo "[$(stamp)] profile FAILED"; tail -3 /tmp/profile_north.err
+fi
+
+echo "[$(stamp)] == 4/4 full bench =="
+out="docs/BENCH_TPU_$(date -u +%Y-%m-%d_%H%M).json"
+if python bench.py > /tmp/bench_window.json 2>/tmp/bench_window.err; then
+  python -c "
+import json
+d = json.load(open('/tmp/bench_window.json'))
+json.dump(d, open('$out', 'w'), indent=2)
+print('wrote $out')" && echo "[$(stamp)] bench OK"
+else
+  echo "[$(stamp)] bench FAILED"; tail -3 /tmp/bench_window.err
+fi
+echo "[$(stamp)] window-2 agenda complete — inspect artifacts and commit"
