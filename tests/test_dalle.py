@@ -266,3 +266,27 @@ class TestChunkedCE:
         D, cfg, params, text, ids = self._setup(4)
         logits = D.dalle_apply(params, text, ids, cfg=cfg)
         assert logits.shape == (2, 22, cfg.total_tokens)
+
+
+def test_north_composition_remat_flash_chunk_matches_plain(key, params):
+    """The tuned bench config composes remat='full' + attn_impl='flash' +
+    chunked CE in one train step (bench.py build_cfg); loss and grads must
+    match the plain dense/xla/un-rematerialized path, since remat and the
+    CE streaming are pure memory strategies and flash is an exact
+    attention algorithm (not an approximation)."""
+    import dataclasses
+
+    north = dataclasses.replace(CFG, remat="full", attn_impl="flash",
+                                loss_chunk=16)
+    plain = CFG
+    text = jax.random.randint(jax.random.fold_in(key, 2), (2, 16), 0, 100)
+    ids = jax.random.randint(jax.random.fold_in(key, 3), (2, 64), 0, 48)
+
+    def loss(p, c):
+        return D.dalle_apply(p, text, ids, cfg=c, return_loss=True)
+
+    l_p, g_p = jax.value_and_grad(loss)(params, plain)
+    l_n, g_n = jax.value_and_grad(loss)(params, north)
+    np.testing.assert_allclose(float(l_n), float(l_p), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.array(a), np.array(b), atol=5e-4), g_p, g_n)
