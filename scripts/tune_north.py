@@ -76,9 +76,18 @@ def main():
                 dt, loss, _ = time_steps(step, params, opt_state, data, key,
                                          args.warmup, args.steps)
             except Exception as e:
+                msg = f"{type(e).__name__}: {e}"
+                # the remote compiler reports HBM exhaustion as an opaque
+                # HTTP 500 whose body carries the allocation dump; classify
+                # so the sweep record reads as "didn't fit" vs "broke"
+                oom_markers = ("RESOURCE_EXHAUSTED", "Allocation type",
+                               "exceeds the limit", "out of memory")
+                kind = ("oom" if any(m in msg for m in oom_markers)
+                        else "error")
                 print(json.dumps({"attn": attn, "batch": batch,
-                                  "heads": heads, "remat": remat,
-                                  "error": f"{type(e).__name__}: {e}"}),
+                                  "heads": heads, "dim_head": dim_head,
+                                  "loss_chunk": chunk, "remat": remat,
+                                  "kind": kind, "error": msg[:300]}),
                       flush=True)
                 continue
             tps = args.steps * batch * cfg.seq_len / dt / n_dev
