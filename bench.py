@@ -313,10 +313,17 @@ def bench_north(args):
     remat = args.remat
     if remat is None:
         remat = tuned.get("remat") or "none"
+    reversible = bool(tuned.get("reversible", False))
+    if reversible and args.remat == "full":
+        # explicit flags win: the reversible engine ignores cfg.remat
+        # (transformer.py reversible branch), so honoring --remat full
+        # means dropping the tuned engine choice
+        reversible = False
     cfg = build_cfg(args.tiny, depth=12 if not args.tiny else 2,
                     attn_impl=attn, loss_chunk=loss_chunk,
                     heads=tuned.get("heads", 8),
-                    dim_head=tuned.get("dim_head", 64), remat=remat)
+                    dim_head=tuned.get("dim_head", 64), remat=remat,
+                    reversible=reversible)
     note = None
     _progress(f"north: compiling train step (attn={attn}, batch={batch})")
     try:
@@ -362,6 +369,8 @@ def bench_north(args):
         "batch": batch,
         "loss": round(loss, 4),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "remat": cfg.remat,
+        "reversible": cfg.reversible,
         "gen_p50_ms": gen_p50,
         "gen_ms_per_token": gen_ms_tok,
         "backend": jax.default_backend(),

@@ -103,7 +103,8 @@ def main():
                     loss_chunk=tuned.get("loss_chunk") or 0,
                     heads=tuned.get("heads", 8),
                     dim_head=tuned.get("dim_head", 64),
-                    remat=tuned.get("remat") or "none")
+                    remat=tuned.get("remat") or "none",
+                    reversible=bool(tuned.get("reversible", False)))
     batch = args.batch or (tuned.get("batch_per_chip", 8) * n_dev
                            if not args.tiny else 4)
     key = jax.random.PRNGKey(0)

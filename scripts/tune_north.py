@@ -32,6 +32,12 @@ def main():
                          "('none,full'); 'full' trades ~1/3 more FLOPs for "
                          "per-layer activation memory, unlocking batches "
                          "that otherwise OOM a 16G v5e chip")
+    ap.add_argument("--reversibles", default="0",
+                    help="comma list of 0/1: run the reversible engine as a "
+                         "sweep dimension (O(1) activation memory by "
+                         "inversion instead of recompute-by-checkpoint; "
+                         "measured FASTER than the sequential stack at "
+                         "batch 8 on 2026-07-30: 110.2k vs 105.2k tok/s)")
     ap.add_argument("--claim_retries", type=int, default=20,
                     help="re-exec for a fresh chip claim this many times "
                          "when backend init stalls/errors (wedged-tunnel "
@@ -63,12 +69,19 @@ def main():
     for hc in args.head_cfgs.split(","):
       heads, dim_head = (int(v) for v in hc.split("x"))
       for remat in args.remats.split(","):
-       for attn in args.attns.split(","):
-        for chunk in (int(c) for c in args.loss_chunks.split(",")):
+       for rev in (bool(int(r)) for r in args.reversibles.split(",")):
+        if rev and remat != "none":
+            # the reversible engine's early-return branch never reaches the
+            # remat logic (transformer.py): rev x remat=full would re-time
+            # a byte-identical config under a false label
+            continue
+        for attn in args.attns.split(","):
+         for chunk in (int(c) for c in args.loss_chunks.split(",")):
           for batch in (int(b) for b in args.batches.split(",")):
             cfg = build_cfg(False, depth=12, attn_impl=attn,
                             loss_chunk=chunk, heads=heads,
-                            dim_head=dim_head, remat=remat)
+                            dim_head=dim_head, remat=remat,
+                            reversible=rev)
             t0 = time.time()
             try:
                 step, params, opt_state, data, key = setup_train(
@@ -87,6 +100,7 @@ def main():
                 print(json.dumps({"attn": attn, "batch": batch,
                                   "heads": heads, "dim_head": dim_head,
                                   "loss_chunk": chunk, "remat": remat,
+                                  "reversible": rev,
                                   "kind": kind, "error": msg[:300]}),
                       flush=True)
                 continue
@@ -95,6 +109,7 @@ def main():
             rec = {"attn": attn, "batch": batch,
                    "batch_per_chip": batch // n_dev, "loss_chunk": chunk,
                    "heads": heads, "dim_head": dim_head, "remat": remat,
+                   "reversible": rev,
                    "tokens_sec_chip": round(tps, 1), "mfu": round(mfu, 4),
                    "loss": round(loss, 4),
                    "setup_s": round(time.time() - t0 - dt, 1)}
@@ -114,7 +129,7 @@ def main():
             def cfg_key(r):
                 return (r.get("attn"), r.get("batch"), r.get("loss_chunk"),
                         r.get("heads", 8), r.get("dim_head", 64),
-                        r.get("remat", "none"))
+                        r.get("remat", "none"), r.get("reversible", False))
 
             merged = {}
             try:
