@@ -45,24 +45,74 @@ if [ ! -f "$stamp" ]; then
   touch "$stamp"
 fi
 
-echo "== train_vae ($VAE_EPOCHS epochs) =="
-python -m dalle_pytorch_tpu.cli.train_vae \
-  --dataPath "$DATA/images" --imageSize "$IMG_SIZE" --batchSize 16 \
-  --n_epochs "$VAE_EPOCHS" --name demovae --num_tokens "$TOKENS" \
-  --codebook_dim "$CDIM" --hidden_dim "$HID" --num_layers "$LAYERS" \
-  --lr 3e-4 --tempsched --models_dir models --results_dir "$OUT" \
-  --metrics "$OUT/vae_loss.jsonl" --log_interval 10
+# Resume support: healthy tunnel windows have been ~16-20 min (2026-07-31)
+# while the full demo needs longer, so each invocation continues from the
+# newest per-epoch checkpoint instead of restarting — successive windows
+# make incremental progress. Loss-curve JSONLs are APPENDED across
+# invocations; records carry epoch + wall time, so plot loss vs epoch (or
+# sort by time), not vs the per-invocation step counter.
+#
+# Same guard as the dataset stamp, for models/: resumed runs take their
+# config from the checkpoint manifest, so a leftover rehearsal checkpoint
+# (different arch knobs) must not hijack a real run via --loadVAE.
+mstamp="models/.demo_stamp_${IMG_SIZE}_${DIM}_${DEPTH}_${TOKENS}_${CDIM}_${HID}_${LAYERS}"
+mkdir -p models
+if [ ! -f "$mstamp" ]; then
+  rm -rf models/demovae-* models/demodalle_dalle-* models/.demo_stamp_*
+  touch "$mstamp"
+fi
 
-echo "== train_dalle ($DALLE_EPOCHS epochs) =="
-python -m dalle_pytorch_tpu.cli.train_dalle \
-  --dataPath "$DATA/images" --imageSize "$IMG_SIZE" --batchSize 16 \
-  --captions_only "$DATA/only.txt" --captions "$DATA/captions.txt" \
-  --vaename demovae --vae_epoch "$((VAE_EPOCHS - 1))" --name demodalle \
-  --n_epochs "$DALLE_EPOCHS" --dim "$DIM" --depth "$DEPTH" --heads 8 \
-  --dim_head "$((DIM / 8))" --num_text_tokens 64 --text_seq_len 32 \
-  --attn_dropout 0.1 --ff_dropout 0.1 --lr 3e-4 --models_dir models \
-  --results_dir "$OUT" --metrics "$OUT/dalle_loss.jsonl" \
-  --log_interval 10 --sample_every 8
+# `latest_epoch NAME` prints the newest checkpoint's epoch for NAME under
+# models/, or -1.
+latest_epoch() {
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - "$1" <<'EOF'
+import sys
+from dalle_pytorch_tpu import checkpoint as ckpt
+found = ckpt.latest("models", sys.argv[1])
+print(-1 if found is None else found[1])
+EOF
+}
+
+vae_done=$(latest_epoch demovae)
+if [ "$vae_done" -ge "$((VAE_EPOCHS - 1))" ]; then
+  echo "== train_vae: complete at epoch $vae_done, skipping =="
+else
+  resume_flags=""
+  remaining="$VAE_EPOCHS"
+  if [ "$vae_done" -ge 0 ]; then
+    resume_flags="--loadVAE demovae"
+    remaining="$((VAE_EPOCHS - vae_done - 1))"
+  fi
+  echo "== train_vae ($remaining of $VAE_EPOCHS epochs) =="
+  python -m dalle_pytorch_tpu.cli.train_vae \
+    --dataPath "$DATA/images" --imageSize "$IMG_SIZE" --batchSize 16 \
+    --n_epochs "$remaining" --name demovae --num_tokens "$TOKENS" \
+    --codebook_dim "$CDIM" --hidden_dim "$HID" --num_layers "$LAYERS" \
+    --lr 3e-4 --tempsched --models_dir models --results_dir "$OUT" \
+    --metrics "$OUT/vae_loss.jsonl" --log_interval 10 $resume_flags
+fi
+
+dalle_done=$(latest_epoch demodalle_dalle)
+if [ "$dalle_done" -ge "$((DALLE_EPOCHS - 1))" ]; then
+  echo "== train_dalle: complete at epoch $dalle_done, skipping =="
+else
+  resume_flags=""
+  remaining="$DALLE_EPOCHS"
+  if [ "$dalle_done" -ge 0 ]; then
+    resume_flags="--load_dalle demodalle"
+    remaining="$((DALLE_EPOCHS - dalle_done - 1))"
+  fi
+  echo "== train_dalle ($remaining of $DALLE_EPOCHS epochs) =="
+  python -m dalle_pytorch_tpu.cli.train_dalle \
+    --dataPath "$DATA/images" --imageSize "$IMG_SIZE" --batchSize 16 \
+    --captions_only "$DATA/only.txt" --captions "$DATA/captions.txt" \
+    --vaename demovae --vae_epoch "$((VAE_EPOCHS - 1))" --name demodalle \
+    --n_epochs "$remaining" --dim "$DIM" --depth "$DEPTH" --heads 8 \
+    --dim_head "$((DIM / 8))" --num_text_tokens 64 --text_seq_len 32 \
+    --attn_dropout 0.1 --ff_dropout 0.1 --lr 3e-4 --models_dir models \
+    --results_dir "$OUT" --metrics "$OUT/dalle_loss.jsonl" \
+    --log_interval 10 --sample_every 8 $resume_flags
+fi
 
 echo "== gen_dalle =="
 for prompt in "a photo of a purple flower" \
