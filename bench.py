@@ -187,7 +187,8 @@ def dalle_train_flops_per_token(cfg) -> float:
 def build_cfg(tiny: bool, depth: int = 12, reversible: bool = False,
               sparse: bool = False, attn_impl: str = "xla",
               loss_chunk: int = 0, heads: int = 8, dim_head: int = 64,
-              remat: str = "none"):
+              remat: str = "none", flash_block_q: int = 128,
+              flash_block_k: int = 128):
     """``heads``/``dim_head`` keep heads*dim_head = 512 (the north config
     fixes dim and depth, not the head split — BASELINE.md); dim_head 128
     fills the MXU's 128-wide contraction in attention, dim_head 64 is the
@@ -227,6 +228,7 @@ def build_cfg(tiny: bool, depth: int = 12, reversible: bool = False,
         dim_head=dim_head,
         sparse_attn=(True, False) * (depth // 2) if sparse else False,
         attn_impl=attn_impl, attn_bwd_impl=attn_bwd,
+        flash_block_q=flash_block_q, flash_block_k=flash_block_k,
         sparse_impl="pallas" if sparse else "ref",
         loss_chunk=loss_chunk, remat=remat)
 
@@ -323,7 +325,9 @@ def bench_north(args):
                     attn_impl=attn, loss_chunk=loss_chunk,
                     heads=tuned.get("heads", 8),
                     dim_head=tuned.get("dim_head", 64), remat=remat,
-                    reversible=reversible)
+                    reversible=reversible,
+                    flash_block_q=tuned.get("flash_block_q", 128),
+                    flash_block_k=tuned.get("flash_block_k", 128))
     note = None
     _progress(f"north: compiling train step (attn={attn}, batch={batch})")
     try:

@@ -60,6 +60,8 @@ class DALLEConfig:
     sparse_block: int = 16
     attn_impl: str = "xla"
     attn_bwd_impl: str = "xla"   # flash backward: 'xla' | 'pallas' kernels
+    flash_block_q: int = 128     # flash kernel tile sizes (transformer cfg)
+    flash_block_k: int = 128
     sparse_impl: str = "ref"
     # MoE FF (beyond reference): 0 = plain GEGLU; >0 experts per layer,
     # expert axis shardable over 'ep'. aux coef weights the Switch
@@ -109,6 +111,8 @@ class DALLEConfig:
             reversible=self.reversible, sparse_attn=self.sparse_attn,
             sparse_block=self.sparse_block, attn_impl=self.attn_impl,
             attn_bwd_impl=self.attn_bwd_impl,
+            flash_block_q=self.flash_block_q,
+            flash_block_k=self.flash_block_k,
             sparse_impl=self.sparse_impl, scale_mode=self.scale_mode,
             remat=self.remat, moe_experts=self.moe_experts,
             moe_k=self.moe_k)

@@ -119,10 +119,13 @@ def attention_apply(params: dict, x: Array, *, heads: int, dim_head: int,
                     dropout_key: Optional[Array] = None,
                     train: bool = False,
                     impl: str = "xla",
-                    bwd_impl: str = "xla") -> Array:
+                    bwd_impl: str = "xla",
+                    block_q: int = 128,
+                    block_k: int = 128) -> Array:
     """Full attention block: qkv proj -> attention -> out proj (+dropout).
     ``bwd_impl`` selects the flash backward ('xla' blockwise | 'pallas'
-    kernels); ignored on the xla forward path."""
+    kernels); ``block_q``/``block_k`` the flash tile sizes. Both are
+    ignored on the xla forward path."""
     if impl not in ("xla", "flash"):
         raise ValueError(f"unknown attention impl {impl!r}; "
                          f"expected 'xla' or 'flash'")
@@ -131,7 +134,8 @@ def attention_apply(params: dict, x: Array, *, heads: int, dim_head: int,
     if impl == "flash":
         from dalle_pytorch_tpu.ops.flash_attention import flash_attention
         out = flash_attention(q, k, v, scale=scale, causal=causal, mask=mask,
-                              bwd_impl=bwd_impl)
+                              bwd_impl=bwd_impl,
+                              block_q=block_q, block_k=block_k)
     else:
         attn = dense_attention_weights(q, k, scale, mask, causal)
         out = jnp.einsum("bhij,bhjd->bhid", attn, v)

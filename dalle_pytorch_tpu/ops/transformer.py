@@ -55,6 +55,11 @@ class TransformerConfig:
     # flash backward: 'xla' blockwise scan | 'pallas' kernels (causal tile
     # skipping); only meaningful with attn_impl='flash'
     attn_bwd_impl: str = "xla"
+    # flash kernel tile sizes (q rows x k cols per grid step); multiples of
+    # the (8, 128) TPU register tile. Tunable: larger k tiles amortize the
+    # per-tile softmax-stats update, larger q tiles cut grid steps
+    flash_block_q: int = 128
+    flash_block_k: int = 128
     sparse_impl: str = "ref"    # 'ref' | 'windowed' | 'pallas'
     # reference uses dim**-0.5 (transformer.py:57); 'head' gives dim_head**-0.5
     scale_mode: str = "dim"
@@ -137,7 +142,9 @@ def attn_branch(layer_params: dict, x: Array, mask: Optional[Array],
                         scale=cfg.scale, causal=cfg.causal, mask=mask,
                         dropout_rate=cfg.attn_dropout, dropout_key=key,
                         train=train, impl=cfg.attn_impl,
-                        bwd_impl=cfg.attn_bwd_impl)
+                        bwd_impl=cfg.attn_bwd_impl,
+                        block_q=cfg.flash_block_q,
+                        block_k=cfg.flash_block_k)
 
     pattern = cfg.sparse_pattern
     if not any(pattern):

@@ -32,6 +32,10 @@ def main():
                          "('none,full'); 'full' trades ~1/3 more FLOPs for "
                          "per-layer activation memory, unlocking batches "
                          "that otherwise OOM a 16G v5e chip")
+    ap.add_argument("--flash_blocks", default="128x128",
+                    help="comma list of flash-kernel block_q x block_k tile "
+                         "sizes (e.g. '128x128,256x256,128x256'); only "
+                         "affects attn impls with a flash forward")
     ap.add_argument("--reversibles", default="0",
                     help="comma list of 0/1: run the reversible engine as a "
                          "sweep dimension (O(1) activation memory by "
@@ -76,12 +80,18 @@ def main():
             # a byte-identical config under a false label
             continue
         for attn in args.attns.split(","):
-         for chunk in (int(c) for c in args.loss_chunks.split(",")):
-          for batch in (int(b) for b in args.batches.split(",")):
+         for i_fb, fb in enumerate(args.flash_blocks.split(",")):
+          if not attn.startswith("flash") and i_fb > 0:
+              continue                  # block sizes don't affect xla attn
+          bq, bk = ((int(v) for v in fb.split("x"))
+                    if attn.startswith("flash") else (128, 128))
+          for chunk in (int(c) for c in args.loss_chunks.split(",")):
+           for batch in (int(b) for b in args.batches.split(",")):
             cfg = build_cfg(False, depth=12, attn_impl=attn,
                             loss_chunk=chunk, heads=heads,
                             dim_head=dim_head, remat=remat,
-                            reversible=rev)
+                            reversible=rev, flash_block_q=bq,
+                            flash_block_k=bk)
             t0 = time.time()
             try:
                 step, params, opt_state, data, key = setup_train(
@@ -101,6 +111,8 @@ def main():
                                   "heads": heads, "dim_head": dim_head,
                                   "loss_chunk": chunk, "remat": remat,
                                   "reversible": rev,
+                                  "flash_block_q": cfg.flash_block_q,
+                                  "flash_block_k": cfg.flash_block_k,
                                   "kind": kind, "error": msg[:300]}),
                       flush=True)
                 continue
@@ -110,6 +122,8 @@ def main():
                    "batch_per_chip": batch // n_dev, "loss_chunk": chunk,
                    "heads": heads, "dim_head": dim_head, "remat": remat,
                    "reversible": rev,
+                   "flash_block_q": cfg.flash_block_q,
+                   "flash_block_k": cfg.flash_block_k,
                    "tokens_sec_chip": round(tps, 1), "mfu": round(mfu, 4),
                    "loss": round(loss, 4),
                    "setup_s": round(time.time() - t0 - dt, 1)}
@@ -129,7 +143,9 @@ def main():
             def cfg_key(r):
                 return (r.get("attn"), r.get("batch"), r.get("loss_chunk"),
                         r.get("heads", 8), r.get("dim_head", 64),
-                        r.get("remat", "none"), r.get("reversible", False))
+                        r.get("remat", "none"), r.get("reversible", False),
+                        r.get("flash_block_q", 128),
+                        r.get("flash_block_k", 128))
 
             merged = {}
             try:
