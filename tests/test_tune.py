@@ -1,0 +1,92 @@
+"""Unit tests for the tune-sweep record merge (scripts/tune_north.py).
+
+docs/TUNE_NORTH.json decides bench_north's recorded defaults, so the
+merge semantics are load-bearing: the committed best must only ever
+improve, re-measured configs must dedupe with the newest value winning,
+old records written before a sweep dimension existed must collapse onto
+the value those runs actually used, and off-backend payloads must be
+discarded.
+"""
+
+import importlib.util
+import os
+
+spec = importlib.util.spec_from_file_location(
+    "tune_north",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "tune_north.py"))
+tune = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(tune)
+
+
+def rec(tps, **kw):
+    r = {"attn": "flash", "batch": 16, "loss_chunk": 256,
+         "heads": 8, "dim_head": 64, "remat": "none", "reversible": False,
+         "flash_block_q": 128, "flash_block_k": 128,
+         "tokens_sec_chip": tps}
+    r.update(kw)
+    return r
+
+
+def test_first_run_writes_run_best():
+    out = tune.merge_tune_payload(None, [rec(100.0)], rec(100.0))
+    assert out["best"]["tokens_sec_chip"] == 100.0
+    assert len(out["results"]) == 1
+    assert out["backend"] == "tpu"
+
+
+def test_prior_best_survives_a_worse_run():
+    prev = {"backend": "tpu", "best": rec(110.0, batch=8),
+            "results": [rec(110.0, batch=8)]}
+    out = tune.merge_tune_payload(prev, [rec(90.0)], rec(90.0))
+    assert out["best"]["tokens_sec_chip"] == 110.0
+    assert out["best"]["batch"] == 8
+    assert len(out["results"]) == 2
+
+
+def test_better_run_replaces_best():
+    prev = {"backend": "tpu", "best": rec(110.0, batch=8),
+            "results": [rec(110.0, batch=8)]}
+    out = tune.merge_tune_payload(prev, [rec(120.0, remat="full")],
+                                  rec(120.0, remat="full"))
+    assert out["best"]["tokens_sec_chip"] == 120.0
+    assert out["best"]["remat"] == "full"
+
+
+def test_remeasured_config_dedupes_latest_wins():
+    prev = {"backend": "tpu", "best": rec(95.0),
+            "results": [rec(95.0)]}
+    out = tune.merge_tune_payload(prev, [rec(97.0)], rec(97.0))
+    assert len(out["results"]) == 1
+    assert out["results"][0]["tokens_sec_chip"] == 97.0
+
+
+def test_pre_dimension_records_collapse_onto_defaults():
+    # a record written before remat/reversible/flash blocks existed is the
+    # same config as an explicit all-defaults record
+    old = {"attn": "flash", "batch": 16, "loss_chunk": 256, "heads": 8,
+           "dim_head": 64, "tokens_sec_chip": 95.0}
+    prev = {"backend": "tpu", "best": old, "results": [old]}
+    out = tune.merge_tune_payload(prev, [rec(96.0)], rec(96.0))
+    assert len(out["results"]) == 1
+    assert out["results"][0]["tokens_sec_chip"] == 96.0
+
+
+def test_off_backend_payload_is_discarded():
+    prev = {"backend": "cpu", "best": rec(9e9),
+            "results": [rec(9e9)]}
+    out = tune.merge_tune_payload(prev, [rec(90.0)], rec(90.0))
+    assert out["best"]["tokens_sec_chip"] == 90.0
+    assert len(out["results"]) == 1
+
+
+def test_remeasured_best_corrects_downward():
+    # a noisy prior best is retired when the SAME config re-measures lower
+    prev = {"backend": "tpu", "best": rec(95.0), "results": [rec(95.0)]}
+    out = tune.merge_tune_payload(prev, [rec(90.0)], rec(90.0))
+    assert out["best"]["tokens_sec_chip"] == 90.0
+
+
+def test_non_dict_prev_payload_is_discarded():
+    out = tune.merge_tune_payload([], [rec(90.0)], rec(90.0))
+    assert out["best"]["tokens_sec_chip"] == 90.0
