@@ -59,6 +59,7 @@ mstamp="models/.demo_stamp_${IMG_SIZE}_${DIM}_${DEPTH}_${TOKENS}_${CDIM}_${HID}_
 mkdir -p models
 if [ ! -f "$mstamp" ]; then
   rm -rf models/demovae-* models/demodalle_dalle-* models/.demo_stamp_*
+  rm -f "$OUT/vae_loss.jsonl" "$OUT/dalle_loss.jsonl"  # curves restart too
   touch "$mstamp"
 fi
 
@@ -122,4 +123,6 @@ for prompt in "a photo of a purple flower" \
     --dalle_epoch "$((DALLE_EPOCHS - 1))" --num_images 8 \
     --models_dir models --results_dir "$OUT"
 done
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+python scripts/plot_demo.py --dir "$OUT" || true
 echo "demo artifacts in $OUT/"
