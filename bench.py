@@ -4,7 +4,8 @@ Default run (``--config all``) measures every BASELINE.json config and emits
 a single combined JSON object: the top-level fields are the north-star
 metric (config 2/5 scaled down to the local chip count), and ``configs``
 holds the DiscreteVAE (1), reversible+rerank (3), depth-64 block-sparse (4)
-numbers plus an on-device Pallas-kernel parity smoke:
+numbers plus a beyond-reference MoE-FF throughput config and an on-device
+Pallas-kernel parity smoke:
 
   * ``value`` — steady-state train tokens/sec/chip (tokens / sec / devices
     actually participating in the sharded step);
@@ -36,7 +37,7 @@ process, so on backend-init failure bench RE-EXECS itself (fresh claim), up
 to --retries times with backoff; if all attempts fail it prints a
 DIAGNOSTIC JSON line (never a bare stack trace) and exits 1.
 
-Usage: python bench.py [--tiny] [--config all|north|vae|rev|sparse|kernels]
+Usage: python bench.py [--tiny] [--config all|north|vae|rev|sparse|moe|kernels]
                        [--attn xla|flash|flash_pallas] [--steps N]
                        [--batch B]
 """
@@ -650,6 +651,44 @@ def bench_kernels(args):
 # entry with backend-failure re-exec
 # ---------------------------------------------------------------------------
 
+def bench_moe(args):
+    """Beyond-reference config: the flagship transformer with every FF
+    replaced by a top-2 MoE of 8 experts (ops/moe.py), trained on a dp
+    mesh. Correctness lives on the CPU mesh (tests/test_moe.py, the
+    dryrun's dp x ep leg); this records the EP layer's on-chip
+    throughput. No MFU is reported: dalle_train_flops_per_token counts
+    the dense FF, not the k/num_experts-scaled MoE cost."""
+    import dataclasses
+
+    import jax
+
+    from dalle_pytorch_tpu.parallel import make_mesh
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"dp": n_dev})
+    attn = args.attn
+    if attn == "auto":
+        attn = "flash" if jax.default_backend() == "tpu" else "xla"
+    cfg = dataclasses.replace(
+        build_cfg(args.tiny, depth=12 if not args.tiny else 2,
+                  attn_impl=attn, loss_chunk=256 if not args.tiny else 0),
+        moe_experts=8 if not args.tiny else 2)
+    batch = args.batch or (8 * n_dev if not args.tiny else 4)
+    steps = max(1, args.steps // 2)
+    step, params, opt_state, data, key = setup_train(cfg, batch, mesh)
+    dt, loss, _ = time_steps(step, params, opt_state, data, key,
+                             args.warmup, steps)
+    tps = steps * batch * cfg.seq_len / dt / n_dev
+    return {
+        "metric": "DALLE MoE-FF (8 experts, top-2) train tokens/sec/chip"
+                  if not args.tiny else "tiny moe",
+        "value": round(tps, 1), "unit": "tokens/sec/chip",
+        "vs_baseline": None, "loss": round(loss, 4),
+        "moe_experts": cfg.moe_experts, "batch": batch,
+        "devices": n_dev, "backend": jax.default_backend(),
+    }
+
+
 def bench_all(args):
     """Every BASELINE config in one combined JSON object. The north star is
     the top level; each config (north included) records its result or its
@@ -662,7 +701,8 @@ def bench_all(args):
                "trace": traceback.format_exc(limit=3)}
     out["configs"] = {}
     for name, fn in (("vae", bench_vae), ("rev", bench_rev),
-                     ("sparse", bench_sparse), ("kernels", bench_kernels)):
+                     ("sparse", bench_sparse), ("moe", bench_moe),
+                     ("kernels", bench_kernels)):
         _progress(f"config {name} ...")
         t0 = time.perf_counter()
         try:
@@ -683,7 +723,7 @@ def main():
     ap.add_argument("--tiny", action="store_true",
                     help="tiny model for CPU smoke runs (not a benchmark)")
     ap.add_argument("--config", default="all",
-                    choices=["all", "north", "vae", "rev", "sparse",
+                    choices=["all", "north", "vae", "rev", "sparse", "moe",
                              "kernels"])
     ap.add_argument("--attn", default="auto",
                     choices=["auto", "xla", "flash", "flash_pallas"],
@@ -745,7 +785,7 @@ def main():
 
     try:
         _emit({"all": bench_all, "north": bench_north, "vae": bench_vae,
-               "rev": bench_rev, "sparse": bench_sparse,
+               "rev": bench_rev, "sparse": bench_sparse, "moe": bench_moe,
                "kernels": bench_kernels}[args.config](args))
     except SystemExit:
         raise

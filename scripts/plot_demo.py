@@ -55,9 +55,14 @@ def main():
                     help="default: <dir>/loss_curves.png")
     args = ap.parse_args()
 
-    import matplotlib
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print("matplotlib not installed (it is not a package dependency); "
+              "pip install matplotlib to render loss curves")
+        return
 
     panels = []
     for fname, title in (("vae_loss.jsonl", "DiscreteVAE recon loss"),
