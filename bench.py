@@ -201,10 +201,11 @@ def build_cfg(tiny: bool, depth: int = 12, reversible: bool = False,
     from dalle_pytorch_tpu.models import dalle as D
     from dalle_pytorch_tpu.models import vae as V
 
-    # the transformer only checks cfg.remat == "full"; any other string
-    # would silently run un-rematerialized under a wrong label
-    if remat not in ("none", "full"):
-        raise ValueError(f"remat must be 'none' or 'full', got {remat!r}")
+    # unknown strings would otherwise silently run un-rematerialized under
+    # a wrong label (the transformer validates too; fail before compiling)
+    if remat not in ("none", "dots", "full"):
+        raise ValueError(
+            f"remat must be 'none', 'dots' or 'full', got {remat!r}")
 
     # 'flash_pallas' = flash forward + the Pallas backward kernels
     attn_bwd = "xla"
@@ -317,10 +318,10 @@ def bench_north(args):
     if remat is None:
         remat = tuned.get("remat") or "none"
     reversible = bool(tuned.get("reversible", False))
-    if reversible and args.remat == "full":
+    if reversible and args.remat in ("dots", "full"):
         # explicit flags win: the reversible engine ignores cfg.remat
-        # (transformer.py reversible branch), so honoring --remat full
-        # means dropping the tuned engine choice
+        # (transformer.py reversible branch), so honoring an explicit
+        # remat request means dropping the tuned engine choice
         reversible = False
     cfg = build_cfg(args.tiny, depth=12 if not args.tiny else 2,
                     attn_impl=attn, loss_chunk=loss_chunk,
@@ -737,9 +738,12 @@ def main():
                     help="chunked-CE head size for the north config "
                          "(0 = dense; default: the committed tuned value, "
                          "else dense)")
-    ap.add_argument("--remat", default=None, choices=["none", "full"],
+    ap.add_argument("--remat", default=None,
+                    choices=["none", "dots", "full"],
                     help="layer-body rematerialization for the north config "
-                         "(default: the committed tuned value, else none)")
+                         "('dots' = recompute vector work only, matmul "
+                         "outputs stay saved; default: the committed tuned "
+                         "value, else none)")
     ap.add_argument("--no_gen", action="store_true",
                     help="skip the generate-latency half")
     ap.add_argument("--retries", type=int, default=3)

@@ -39,16 +39,23 @@ done
 echo "[$(stamp)] tunnel healthy — running the window-2 agenda"
 
 echo "[$(stamp)] == 1/4 remat + reversible sweep =="
-python scripts/tune_north.py --attns flash --batches 8,16,32,64 \
-  --loss_chunks 256 --remats none,full --claim_retries 2 \
-  && echo "[$(stamp)] remat sweep OK" || echo "[$(stamp)] remat sweep FAILED"
-# reversible leg: O(1) activation memory by inversion — measured faster
-# than sequential at batch 8 on 2026-07-30 (110.2k vs 105.2k tok/s), and
-# like remat it should unlock batch>=32
-python scripts/tune_north.py --attns flash --batches 8,16,32,64 \
+# legs sized to known memory behavior (2026-07-31 sweep: un-rematerialized
+# OOMs at batch>=32; 'dots' reclaims ~65% of residual bytes at near-zero
+# FLOP cost, 'full' ~91% at ~1/3 more FLOPs; reversible is O(1) by
+# inversion and measured FASTER than sequential at batch 8 on 2026-07-30)
+python scripts/tune_north.py --attns flash --batches 8,16 \
+  --loss_chunks 256 --remats none --claim_retries 2 \
+  && echo "[$(stamp)] none leg OK" || echo "[$(stamp)] none leg FAILED"
+python scripts/tune_north.py --attns flash --batches 16,32,64 \
+  --loss_chunks 256 --remats dots --claim_retries 2 \
+  && echo "[$(stamp)] dots leg OK" || echo "[$(stamp)] dots leg FAILED"
+python scripts/tune_north.py --attns flash --batches 32,64 \
+  --loss_chunks 256 --remats full --claim_retries 2 \
+  && echo "[$(stamp)] full leg OK" || echo "[$(stamp)] full leg FAILED"
+python scripts/tune_north.py --attns flash --batches 8,32,64 \
   --loss_chunks 256 --reversibles 1 --claim_retries 2 \
-  && echo "[$(stamp)] reversible sweep OK" \
-  || echo "[$(stamp)] reversible sweep FAILED"
+  && echo "[$(stamp)] reversible leg OK" \
+  || echo "[$(stamp)] reversible leg FAILED"
 
 echo "[$(stamp)] == 2/4 tpu_demo =="
 bash scripts/tpu_demo.sh && echo "[$(stamp)] demo OK" \

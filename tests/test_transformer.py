@@ -107,9 +107,14 @@ def test_windowed_impl_matches_ref_in_stack(key):
     np.testing.assert_allclose(np.array(y_w), np.array(y_r), atol=1e-5)
 
 
-def test_remat_matches_plain(key):
+@pytest.mark.parametrize("mode", ["dots", "full"])
+def test_remat_matches_plain(key, mode):
+    """'full' recomputes the whole layer body; 'dots' keeps matmul outputs
+    and recomputes only vector work (measured ~65% residual-byte cut on
+    the flash north stack). In f32 the recompute is deterministic, so
+    loss AND grads match the un-rematerialized path tightly."""
     cfg_r = TransformerConfig(dim=32, depth=3, seq_len=16, heads=2,
-                              dim_head=16, remat="full")
+                              dim_head=16, remat=mode)
     params = transformer_init(key, CFG)
     x = jax.random.normal(key, (2, 16, 32))
 
