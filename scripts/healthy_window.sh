@@ -17,30 +17,10 @@
 # Nothing is committed automatically — inspect and commit the artifacts.
 set -u
 cd "$(dirname "$0")/.."
-stamp() { date -u +"%H:%M:%S"; }
+. scripts/window_lib.sh
 
-echo "[$(stamp)] waiting for a healthy tunnel (10-min probe deadline/try)"
-until BENCH_INIT_DEADLINE_S=${BENCH_INIT_DEADLINE_S:-600} \
-      python - <<'EOF'
-import os, sys, threading
-ok = {}
-def probe():
-    try:
-        import jax
-        ok["d"] = jax.devices()
-    except Exception:
-        pass
-t = threading.Thread(target=probe, daemon=True)
-t.start()
-t.join(float(os.environ.get("BENCH_INIT_DEADLINE_S", "600")))
-sys.stdout.flush()
-os._exit(0 if "d" in ok else 1)
-EOF
-do
-  echo "[$(stamp)] still wedged; sleeping 120s"
-  sleep 120
-done
-echo "[$(stamp)] tunnel healthy — running the agenda"
+wait_healthy_tunnel
+echo "[$(stamp)] running the agenda"
 
 echo "[$(stamp)] == 1/5 tune_north =="
 python scripts/tune_north.py --attns xla,flash,flash_pallas \
@@ -55,16 +35,7 @@ python scripts/tune_north.py --attns flash,xla --batches 32,64 \
   || echo "[$(stamp)] head-split tune FAILED"
 
 echo "[$(stamp)] == 2/5 full bench =="
-out="docs/BENCH_TPU_$(date -u +%Y-%m-%d_%H%M).json"
-if python bench.py > /tmp/bench_window.json 2>/tmp/bench_window.err; then
-  python -c "
-import json
-d = json.load(open('/tmp/bench_window.json'))
-json.dump(d, open('$out', 'w'), indent=2)
-print('wrote $out')" && echo "[$(stamp)] bench OK"
-else
-  echo "[$(stamp)] bench FAILED"; tail -3 /tmp/bench_window.err
-fi
+run_full_bench window
 
 echo "[$(stamp)] == 3/5 tpu_smoke =="
 bash scripts/tpu_smoke.sh && echo "[$(stamp)] smoke OK" \

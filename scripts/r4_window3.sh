@@ -9,35 +9,11 @@
 #   2. re-record the full bench if the sweep moved the tuned best
 set -u
 cd "$(dirname "$0")/.."
-stamp() { date -u +"%H:%M:%S"; }
+. scripts/window_lib.sh
 
-echo "[$(stamp)] waiting for a healthy tunnel (10-min probe deadline/try)"
-until BENCH_INIT_DEADLINE_S=${BENCH_INIT_DEADLINE_S:-600} \
-      python - <<'EOF'
-import os, sys, threading
-ok = {}
-def probe():
-    try:
-        import jax
-        ok["d"] = jax.devices()
-    except Exception:
-        pass
-t = threading.Thread(target=probe, daemon=True)
-t.start()
-t.join(float(os.environ.get("BENCH_INIT_DEADLINE_S", "600")))
-sys.stdout.flush()
-os._exit(0 if "d" in ok else 1)
-EOF
-do
-  echo "[$(stamp)] still wedged; sleeping 120s"
-  sleep 120
-done
-echo "[$(stamp)] tunnel healthy — running the window-3 agenda"
-
-best_before=$(python -c "
-import json
-try: print(json.load(open('docs/TUNE_NORTH.json'))['best']['tokens_sec_chip'])
-except Exception: print(0)")
+wait_healthy_tunnel
+echo "[$(stamp)] running the window-3 agenda"
+best_before=$(tuned_best)
 
 echo "[$(stamp)] == 1/2 flash tile sweep (best so far: $best_before) =="
 python scripts/tune_north.py --attns flash --batches 8,16 \
@@ -45,25 +21,6 @@ python scripts/tune_north.py --attns flash --batches 8,16 \
   --claim_retries 2 \
   && echo "[$(stamp)] tile sweep OK" || echo "[$(stamp)] tile sweep FAILED"
 
-best_after=$(python -c "
-import json
-try: print(json.load(open('docs/TUNE_NORTH.json'))['best']['tokens_sec_chip'])
-except Exception: print(0)")
-
-if python -c "exit(0 if float('$best_after') > float('$best_before') else 1)"
-then
-  echo "[$(stamp)] == 2/2 full bench (best improved: $best_before -> $best_after) =="
-  out="docs/BENCH_TPU_$(date -u +%Y-%m-%d_%H%M).json"
-  if python bench.py > /tmp/bench_w3.json 2>/tmp/bench_w3.err; then
-    python -c "
-import json
-d = json.load(open('/tmp/bench_w3.json'))
-json.dump(d, open('$out', 'w'), indent=2)
-print('wrote $out')" && echo "[$(stamp)] bench OK"
-  else
-    echo "[$(stamp)] bench FAILED"; tail -3 /tmp/bench_w3.err
-  fi
-else
-  echo "[$(stamp)] tuned best unchanged ($best_after); skipping re-bench"
-fi
+echo "[$(stamp)] == 2/2 conditional re-bench =="
+rebench_if_improved "$best_before" w3
 echo "[$(stamp)] window-3 agenda complete"

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Round-4 fourth-wave agenda: cheap micro-sweep around the measured
+# optimum, informed by the 2026-07-31 03:44 window's answer that MFU
+# FALLS with batch (115.0k@8 > 92.4k@16 > every 32/64 point):
+#   1. probe BELOW batch 8 (4, 6) — the trend says smaller may win
+#   2. the 4x128 head split at the batch-8 winner point (its window-1
+#      leg ran only at batches 32/64 which OOM'd; never measured)
+#   3. loss_chunk 128/512 around the winning 256
+#   4. re-record the full bench iff the tuned best moved
+# Usage (after r4_window2/r4_window3 finish, or standalone):
+#   nohup bash scripts/r4_window4.sh > /tmp/r4_window4.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+. scripts/window_lib.sh
+
+wait_healthy_tunnel
+echo "[$(stamp)] running the window-4 agenda"
+best_before=$(tuned_best)
+
+echo "[$(stamp)] == 1/4 small-batch probe (best so far: $best_before) =="
+python scripts/tune_north.py --attns flash --batches 4,6 \
+  --loss_chunks 256 --claim_retries 2 \
+  && echo "[$(stamp)] small-batch leg OK" \
+  || echo "[$(stamp)] small-batch leg FAILED"
+
+echo "[$(stamp)] == 2/4 4x128 head split at batch 8 =="
+python scripts/tune_north.py --attns flash,xla --batches 8 \
+  --loss_chunks 256 --head_cfgs 4x128 --claim_retries 2 \
+  && echo "[$(stamp)] head-split leg OK" \
+  || echo "[$(stamp)] head-split leg FAILED"
+
+echo "[$(stamp)] == 3/4 loss_chunk 128/512 at batch 8 =="
+python scripts/tune_north.py --attns flash --batches 8 \
+  --loss_chunks 128,512 --claim_retries 2 \
+  && echo "[$(stamp)] loss-chunk leg OK" \
+  || echo "[$(stamp)] loss-chunk leg FAILED"
+
+echo "[$(stamp)] == 4/4 conditional re-bench =="
+rebench_if_improved "$best_before" w4
+echo "[$(stamp)] window-4 agenda complete"
