@@ -64,12 +64,94 @@ def _emit(obj, code=0):
 
 
 def _progress(msg: str) -> None:
-    """Stderr progress note — stdout stays one JSON line for the driver."""
+    """Stderr progress note — stdout stays one JSON line for the driver.
+    Every note also beats the stall watchdog: progress = liveness."""
+    _beat(msg)
     print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
           file=sys.stderr, flush=True)
 
 
 _T0 = time.perf_counter()
+
+# --- mid-run stall watchdog ------------------------------------------------
+# claim_backend covers a tunnel that is wedged at INIT; this covers one that
+# wedges MID-RUN (2026-07-31 04:19: the kernels config blocked >24 min with
+# zero CPU after five configs had already measured — and the whole run's
+# numbers were lost with it). The watchdog emits whatever bench_all has
+# completed so far, clearly marked partial, instead of hanging forever.
+
+_hb = {"t": time.monotonic(), "label": "init", "done": False}
+_partial: dict = {}           # bench_all's in-progress combined output
+
+
+def _beat(label: str) -> None:
+    _hb["t"] = time.monotonic()
+    _hb["label"] = label
+
+
+def _start_stall_watchdog():
+    """Daemon thread: if no _beat for BENCH_STALL_DEADLINE_S (default 900 —
+    a healthy config beats every <=150 s, see config_wall_s in the
+    committed artifacts; first-run remote compiles stay well under 900),
+    emit the partial result (exit 0, ``partial: true``) when the north
+    number is in, else fall back to the newest committed artifact marked
+    stale (exit 1). Set the env to 0 to disable."""
+    import threading
+    try:
+        deadline = float(os.environ.get("BENCH_STALL_DEADLINE_S", "900"))
+    except ValueError as e:      # a typo'd env var must not cost the window
+        _progress(f"BENCH_STALL_DEADLINE_S unparseable ({e}); using 900")
+        deadline = 900.0
+    if deadline <= 0:
+        return
+
+    def _watch():
+        while True:
+            time.sleep(min(15.0, max(deadline / 4, 0.05)))
+            if _hb["done"]:
+                return
+            idle = time.monotonic() - _hb["t"]
+            if idle < deadline:
+                continue
+            failure = {"error": "no progress for %.0f s (tunnel wedged "
+                                "mid-run?)" % idle,
+                       "stalled_in": _hb["label"]}
+            if _partial.get("value"):
+                try:
+                    # snapshot: ``configs`` is shared with a bench_all that
+                    # may (on a false-positive fire) still be mutating it
+                    out = {**_partial,
+                           "configs": dict(_partial.get("configs", {}))}
+                    line = json.dumps(out | {"partial": True,
+                                             "stall": failure})
+                except RuntimeError:       # dict changed size mid-copy:
+                    continue               # main thread is alive, not stuck
+                print(line, flush=True)
+                os._exit(0)
+            _emit_stale_fallback({"metric": "bench failed: stalled mid-run",
+                                  **failure})
+
+    threading.Thread(target=_watch, daemon=True).start()
+
+
+def _emit_stale_fallback(failure: dict):
+    """Print the newest committed on-TPU artifact marked stale (or, with no
+    artifact, the bare ``failure`` diagnostic) and exit 1. The one shared
+    shape for every tunnel-outage degradation — init wedge and mid-run
+    stall must emit identically (r3 lesson: an outage should degrade the
+    perf record, never delete it)."""
+    stale = _latest_committed_artifact()
+    if stale is not None:
+        payload, path = stale
+        payload["stale"] = True
+        payload["stale_artifact"] = os.path.relpath(
+            path, os.path.dirname(os.path.abspath(__file__)))
+        payload["stale_reason"] = failure
+        print(json.dumps(payload), flush=True)
+    else:
+        print(json.dumps({"value": None, "unit": None, "vs_baseline": None,
+                          **failure}), flush=True)
+    os._exit(1)
 
 
 def _enable_compile_cache():
@@ -139,7 +221,10 @@ def _latest_committed_artifact():
         try:
             with open(path) as f:
                 payload = json.load(f)
-            if payload.get("value") and payload.get("backend") == "tpu":
+            # a partial payload (mid-run stall emit) is a degraded record
+            # already — never resurface it as the "last real numbers"
+            if (payload.get("value") and payload.get("backend") == "tpu"
+                    and not payload.get("partial")):
                 return payload, path
         except (OSError, ValueError):
             continue
@@ -429,9 +514,12 @@ def bench_generate(cfg, params, args, clip_bundle=None, reps=None):
         run = functools.partial(gen, params, vae_params, text)
         sync = _fetch
 
+    _progress("gen: compiling sampler"
+              + (" (rerank)" if clip_bundle is not None else ""))
     sync(run(jax.random.fold_in(key, 0)))     # compile + first run
     times = []
     for i in range(reps or args.gen_reps):
+        _beat(f"gen rep {i}")
         t0 = time.perf_counter()
         sync(run(jax.random.fold_in(key, 1 + i)))
         times.append((time.perf_counter() - t0) * 1e3)
@@ -468,6 +556,7 @@ def bench_vae(args):
     imgs = jax.random.uniform(key, (batch, cfg.image_size, cfg.image_size,
                                     3), jnp.bfloat16, -1, 1)
     data = shard_batch(mesh, {"images": imgs})
+    _progress("vae: compiling train step")
     dt, loss, _ = time_steps(step, params, opt_state, data, key,
                              args.warmup, args.steps)
     ips = args.steps * batch / dt / n_dev
@@ -495,6 +584,7 @@ def bench_rev(args):
     cfg = build_cfg(args.tiny, depth=12 if not args.tiny else 2,
                     reversible=True, attn_impl=args.attn if args.attn != "auto"
                     else "xla")
+    _progress("rev: compiling train step")
     step, params, opt_state, data, key = setup_train(cfg, batch, mesh)
     dt, loss, params = time_steps(step, params, opt_state, data, key,
                                   args.warmup, args.steps)
@@ -546,6 +636,7 @@ def bench_sparse(args):
     steps = max(1, args.steps // 2)           # depth-64 x2 impls: keep short
     results = {}
     for impl in ("windowed", "pallas", "ref"):
+        _progress(f"sparse: compiling impl={impl}")
         cfg = dataclasses.replace(build_cfg(args.tiny, depth=depth,
                                             sparse=True), sparse_impl=impl)
         step, params, opt_state, data, key = setup_train(cfg, batch, mesh)
@@ -624,6 +715,7 @@ def bench_kernels(args):
     for name, fn, ref in (("flash", flash, dense_ref),
                           ("flash_pallas_bwd", flash_pallas_bwd, dense_ref),
                           ("block_sparse", bs, bs_ref)):
+        _progress(f"kernels: compiling {name}")
         if name != "flash_pallas_bwd":
             # bwd_impl only changes the custom_vjp backward — re-checking
             # the byte-identical forward would just pay a second compile
@@ -676,6 +768,7 @@ def bench_moe(args):
         moe_experts=8 if not args.tiny else 2)
     batch = args.batch or (8 * n_dev if not args.tiny else 4)
     steps = max(1, args.steps // 2)
+    _progress("moe: compiling train step")
     step, params, opt_state, data, key = setup_train(cfg, batch, mesh)
     dt, loss, _ = time_steps(step, params, opt_state, data, key,
                              args.warmup, steps)
@@ -701,6 +794,10 @@ def bench_all(args):
                "vs_baseline": None, "error": f"{type(e).__name__}: {e}",
                "trace": traceback.format_exc(limit=3)}
     out["configs"] = {}
+    # share the in-progress object with the stall watchdog: the nested
+    # ``configs`` dict is the same object, so completed configs are visible
+    # to a partial emit the moment they land
+    _partial.update(out)
     for name, fn in (("vae", bench_vae), ("rev", bench_rev),
                      ("sparse", bench_sparse), ("moe", bench_moe),
                      ("kernels", bench_kernels)):
@@ -768,32 +865,21 @@ def main():
     claim = claim_backend(args.retries)
     if claim is not None:
         err, attempts = claim
-        failure = {"metric": "bench failed: TPU backend init", "value": None,
-                   "unit": None, "vs_baseline": None, "error": str(err),
-                   "attempts": attempts}
-        # Outage fallback (r3 lesson: a wedged tunnel at round end zeroed a
-        # whole round's perf evidence): surface the most recent COMMITTED
-        # on-TPU artifact, clearly marked stale, so the outage degrades the
-        # record instead of deleting it. The honest failure stays attached.
-        stale = _latest_committed_artifact()
-        if stale is not None:
-            payload, path = stale
-            payload["stale"] = True
-            payload["stale_artifact"] = os.path.relpath(
-                path, os.path.dirname(os.path.abspath(__file__)))
-            payload["stale_reason"] = failure
-            print(json.dumps(payload), flush=True)
-        else:
-            print(json.dumps(failure), flush=True)
-        os._exit(1)                        # daemon thread may still pend
+        # note: _emit_stale_fallback os._exits 1 (daemon thread may pend)
+        _emit_stale_fallback({"metric": "bench failed: TPU backend init",
+                              "error": str(err), "attempts": attempts})
 
+    _start_stall_watchdog()
     try:
-        _emit({"all": bench_all, "north": bench_north, "vae": bench_vae,
+        out = {"all": bench_all, "north": bench_north, "vae": bench_vae,
                "rev": bench_rev, "sparse": bench_sparse, "moe": bench_moe,
-               "kernels": bench_kernels}[args.config](args))
+               "kernels": bench_kernels}[args.config](args)
+        _hb["done"] = True
+        _emit(out)
     except SystemExit:
         raise
     except Exception as e:
+        _hb["done"] = True
         _emit({"metric": f"bench failed: {args.config}", "value": None,
                "unit": None, "vs_baseline": None,
                "error": f"{type(e).__name__}: {e}",

@@ -29,6 +29,58 @@ def test_latest_committed_artifact_shape():
     assert os.path.basename(path).startswith("BENCH_TPU_")
 
 
+def test_midrun_stall_emits_partial():
+    """A tunnel that wedges MID-RUN (2026-07-31 04:19 pattern) must emit
+    the configs measured so far as a ``partial: true`` payload, exit 0."""
+    script = (
+        "import json, os, time\n"
+        "import bench\n"
+        "bench._partial.update({'metric': 'm', 'value': 123.4,\n"
+        "                       'unit': 'tokens/sec/chip',\n"
+        "                       'configs': {'vae': {'value': 1.0}}})\n"
+        "bench._beat('config kernels ...')\n"
+        "bench._start_stall_watchdog()\n"
+        "time.sleep(30)\n"                    # watchdog must fire first
+        "raise SystemExit('watchdog never fired')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script], cwd=REPO, capture_output=True,
+        text=True, timeout=60,
+        env={**os.environ, "BENCH_STALL_DEADLINE_S": "0.2",
+             "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    d = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert d["partial"] is True
+    assert d["value"] == 123.4
+    assert d["configs"]["vae"]["value"] == 1.0
+    assert d["stall"]["stalled_in"] == "config kernels ..."
+
+
+def test_midrun_stall_without_north_falls_back_stale():
+    """If the stall hits before the north number exists, degrade to the
+    newest committed artifact (stale) — same contract as an init wedge."""
+    script = (
+        "import time\n"
+        "import bench\n"
+        "bench._start_stall_watchdog()\n"
+        "time.sleep(30)\n"
+        "raise SystemExit('watchdog never fired')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script], cwd=REPO, capture_output=True,
+        text=True, timeout=60,
+        env={**os.environ, "BENCH_STALL_DEADLINE_S": "0.2",
+             "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stderr
+    d = json.loads(proc.stdout.strip().splitlines()[-1])
+    if _has_artifact():
+        assert d["stale"] is True
+        assert d["stale_reason"]["stalled_in"] == "init"
+    else:
+        assert d["value"] is None
+        assert "stalled_in" in d
+
+
 def test_wedged_tunnel_emits_stale_fallback():
     """Simulated wedge (zero init deadline): stdout is ONE JSON line
     carrying the last real numbers + stale=true + the honest failure."""
