@@ -89,13 +89,18 @@ def _beat(label: str) -> None:
     _hb["label"] = label
 
 
-def _start_stall_watchdog():
+def _start_stall_watchdog(on_stall=None):
     """Daemon thread: if no _beat for BENCH_STALL_DEADLINE_S (default 900 —
     a healthy config beats every <=150 s, see config_wall_s in the
     committed artifacts; first-run remote compiles stay well under 900),
+    call ``on_stall(failure)`` — normally it exits the process; if it
+    returns, the watch loop simply re-fires on a later check. bench's
+    default:
     emit the partial result (exit 0, ``partial: true``) when the north
     number is in, else fall back to the newest committed artifact marked
-    stale (exit 1). Set the env to 0 to disable."""
+    stale (exit 1). Set the env to 0 to disable. Scripts that share the
+    chip (tune_north, profile_north) pass their own on_stall; they also
+    share _beat via the public ``beat`` alias below."""
     import threading
     try:
         deadline = float(os.environ.get("BENCH_STALL_DEADLINE_S", "900"))
@@ -105,6 +110,27 @@ def _start_stall_watchdog():
     if deadline <= 0:
         return
 
+    def _bench_on_stall(failure):
+        if _partial.get("value"):
+            try:
+                # snapshot: ``configs`` is shared with a bench_all that
+                # may (on a false-positive fire) still be mutating it
+                out = {**_partial,
+                       "configs": dict(_partial.get("configs", {}))}
+                line = json.dumps(out | {"partial": True,
+                                         "stall": failure})
+            except RuntimeError:           # dict changed size mid-copy:
+                return                     # main thread is alive, not stuck
+            print(line, flush=True)
+            os._exit(0)
+        _emit_stale_fallback({"metric": "bench failed: stalled mid-run",
+                              **failure})
+
+    handler = on_stall or _bench_on_stall
+    # the heartbeat dates from module import; a slow-but-successful claim
+    # (up to BENCH_INIT_DEADLINE_S) must not count toward the stall idle
+    _beat("watchdog start")
+
     def _watch():
         while True:
             time.sleep(min(15.0, max(deadline / 4, 0.05)))
@@ -113,25 +139,16 @@ def _start_stall_watchdog():
             idle = time.monotonic() - _hb["t"]
             if idle < deadline:
                 continue
-            failure = {"error": "no progress for %.0f s (tunnel wedged "
-                                "mid-run?)" % idle,
-                       "stalled_in": _hb["label"]}
-            if _partial.get("value"):
-                try:
-                    # snapshot: ``configs`` is shared with a bench_all that
-                    # may (on a false-positive fire) still be mutating it
-                    out = {**_partial,
-                           "configs": dict(_partial.get("configs", {}))}
-                    line = json.dumps(out | {"partial": True,
-                                             "stall": failure})
-                except RuntimeError:       # dict changed size mid-copy:
-                    continue               # main thread is alive, not stuck
-                print(line, flush=True)
-                os._exit(0)
-            _emit_stale_fallback({"metric": "bench failed: stalled mid-run",
-                                  **failure})
+            handler({"error": "no progress for %.0f s (tunnel wedged "
+                              "mid-run?)" % idle,
+                     "stalled_in": _hb["label"]})
 
     threading.Thread(target=_watch, daemon=True).start()
+
+
+# public surface for sibling scripts (tune_north, profile_north)
+beat = _beat
+start_stall_watchdog = _start_stall_watchdog
 
 
 def _emit_stale_fallback(failure: dict):

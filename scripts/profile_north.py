@@ -74,6 +74,7 @@ def main():
     import numpy as np
     import optax
 
+    import bench
     from bench import build_cfg, setup_train, time_steps, _fetch
     from dalle_pytorch_tpu.models import dalle as D
     from dalle_pytorch_tpu.ops import attention as attn_ops
@@ -119,8 +120,24 @@ def main():
 
     def note(msg):
         # progress to stderr so a hang is localizable to a piece (the
-        # 2026-07-31 run sat silent for 25 min before being killed)
+        # 2026-07-31 run sat silent for 25 min before being killed);
+        # every note also beats the shared stall watchdog
+        bench.beat(msg)
         print(f"[profile] {msg}", file=sys.stderr, flush=True)
+
+    # Mid-run stall protection: emit the pieces measured so far as ONE
+    # partial JSON line (exit 0 — a partial profile is still a profile)
+    # instead of hanging the window orchestrator forever on a wedge.
+    def _on_stall(failure):
+        try:
+            line = json.dumps({**results, "partial": True, "stall": failure,
+                               "backend": jax.default_backend()})
+        except RuntimeError:     # results mutated mid-copy: main is alive,
+            return               # let the watch loop re-check later
+        print(line, flush=True)
+        os._exit(0)
+
+    bench.start_stall_watchdog(on_stall=_on_stall)
 
     # -- attention fwd+bwd, all impls, one layer x depth -------------------
     x = jax.random.normal(key, (b, h_dim, n, dh), dt)

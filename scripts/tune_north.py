@@ -25,24 +25,45 @@ def cfg_key(r):
             r.get("flash_block_q", 128), r.get("flash_block_k", 128))
 
 
-def merge_tune_payload(prev, results, best, backend="tpu"):
-    """Fold this run's ``results``/``best`` into the previously committed
-    payload. Per-config records dedupe by cfg_key with the latest
-    measurement winning; ``best`` is then recomputed over the MERGED set,
-    so a prior winner survives until beaten — but a re-measurement of
-    that same config replaces its number (a noisy best is correctable,
-    never pinned forever). A payload from a different backend is
-    discarded wholesale (CPU smoke numbers must never sit beside chip
-    numbers)."""
+def merge_tune_payload(prev, results, backend="tpu"):
+    """Fold this run's ``results`` into the previously committed payload.
+    Per-config records dedupe by cfg_key with the latest measurement
+    winning; ``best`` is recomputed over the MERGED set, so a prior winner
+    survives until beaten — but a re-measurement of that same config
+    replaces its number (a noisy best is correctable, never pinned
+    forever). A payload from a different backend is discarded wholesale
+    (CPU smoke numbers must never sit beside chip numbers)."""
     merged = {}
     if isinstance(prev, dict) and prev.get("backend") == backend:
         merged = {cfg_key(r): r for r in prev.get("results", [])}
     merged.update({cfg_key(r): r for r in results})  # latest wins
-    # ``best`` (this run's winner) is already in ``merged``; recompute over
-    # the merged set rather than trusting either run's label
     best = max(merged.values(), key=lambda r: r["tokens_sec_chip"])
     return {"best": best, "results": list(merged.values()),
             "backend": backend}
+
+
+def _write_merged(results, out=None):
+    """Merge ``results`` into docs/TUNE_NORTH.json (latest-wins per config,
+    best recomputed over the merged set) and return the path. ``out``
+    overrides the destination (tests)."""
+    out = out or os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "TUNE_NORTH.json")
+    prev = None
+    try:
+        with open(out) as f:
+            prev = json.load(f)
+    except (OSError, ValueError):
+        pass
+    payload = merge_tune_payload(prev, results)
+    # atomic replace: this runs on the per-point hot path and the process
+    # can die at any moment (watchdog os._exit, orchestrator kill) — a
+    # truncated file would silently wipe the whole banked record, since
+    # every reader treats a JSON error as "no payload"
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2)
+    os.replace(tmp, out)
+    return out
 
 
 def main():
@@ -95,9 +116,20 @@ def main():
 
     import jax
 
+    import bench
     from bench import (_bf16_peak, build_cfg, dalle_train_flops_per_token,
                        setup_train, time_steps)
     from dalle_pytorch_tpu.parallel import make_mesh
+
+    # Mid-sweep stall protection (same wedge pattern bench guards against):
+    # measured points are flushed to TUNE_NORTH.json as they land (below),
+    # so on stall just report and exit — nothing is lost, and the detached
+    # window orchestrator's next step isn't blocked forever.
+    def _on_stall(failure):
+        print(json.dumps({"sweep_stalled": True, **failure}), flush=True)
+        os._exit(1)
+
+    bench.start_stall_watchdog(on_stall=_on_stall)
 
     n_dev = len(jax.devices())
     mesh = make_mesh({"dp": n_dev})
@@ -125,6 +157,9 @@ def main():
                             dim_head=dim_head, remat=remat,
                             reversible=rev, flash_block_q=bq,
                             flash_block_k=bk)
+            bench.beat(f"point attn={attn} b={batch} chunk={chunk} "
+                       f"remat={remat} rev={rev} {heads}x{dim_head} "
+                       f"{bq}x{bk}")
             t0 = time.time()
             try:
                 step, params, opt_state, data, key = setup_train(
@@ -162,27 +197,20 @@ def main():
                    "setup_s": round(time.time() - t0 - dt, 1)}
             results.append(rec)
             print(json.dumps(rec), flush=True)
+            # flush the merged record NOW: a later stall/wedge (or a kill)
+            # must not cost the points already measured. bench.py reads
+            # this as its north-config defaults (bench_north); committing
+            # it is how a sweep's winner becomes the recorded config.
+            # Successive sweeps only ever IMPROVE the record: merge keeps
+            # the existing best until beaten.
+            if jax.default_backend() == "tpu":
+                _write_merged(results)
 
     if results:
         best = max(results, key=lambda r: r["tokens_sec_chip"])
         print(json.dumps({"best": best}), flush=True)
-        # bench.py reads this as its north-config defaults (bench_north);
-        # committing it is how a sweep's winner becomes the recorded
-        # config. Successive sweeps only ever IMPROVE the record: keep the
-        # existing best when it beats this run's.
         if jax.default_backend() == "tpu":
-            out = os.path.join(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))), "docs", "TUNE_NORTH.json")
-            prev = None
-            try:
-                with open(out) as f:
-                    prev = json.load(f)
-            except (OSError, ValueError):
-                pass
-            payload = merge_tune_payload(prev, results, best)
-            with open(out, "w") as f:
-                json.dump(payload, f, indent=2)
-            print(json.dumps({"wrote": out}), flush=True)
+            print(json.dumps({"wrote": _write_merged(results)}), flush=True)
 
 
 if __name__ == "__main__":

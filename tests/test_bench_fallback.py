@@ -38,8 +38,8 @@ def test_midrun_stall_emits_partial():
         "bench._partial.update({'metric': 'm', 'value': 123.4,\n"
         "                       'unit': 'tokens/sec/chip',\n"
         "                       'configs': {'vae': {'value': 1.0}}})\n"
-        "bench._beat('config kernels ...')\n"
         "bench._start_stall_watchdog()\n"
+        "bench._beat('config kernels ...')\n"
         "time.sleep(30)\n"                    # watchdog must fire first
         "raise SystemExit('watchdog never fired')\n"
     )
@@ -75,7 +75,7 @@ def test_midrun_stall_without_north_falls_back_stale():
     d = json.loads(proc.stdout.strip().splitlines()[-1])
     if _has_artifact():
         assert d["stale"] is True
-        assert d["stale_reason"]["stalled_in"] == "init"
+        assert d["stale_reason"]["stalled_in"] == "watchdog start"
     else:
         assert d["value"] is None
         assert "stalled_in" in d

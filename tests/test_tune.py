@@ -29,7 +29,7 @@ def rec(tps, **kw):
 
 
 def test_first_run_writes_run_best():
-    out = tune.merge_tune_payload(None, [rec(100.0)], rec(100.0))
+    out = tune.merge_tune_payload(None, [rec(100.0)])
     assert out["best"]["tokens_sec_chip"] == 100.0
     assert len(out["results"]) == 1
     assert out["backend"] == "tpu"
@@ -38,7 +38,7 @@ def test_first_run_writes_run_best():
 def test_prior_best_survives_a_worse_run():
     prev = {"backend": "tpu", "best": rec(110.0, batch=8),
             "results": [rec(110.0, batch=8)]}
-    out = tune.merge_tune_payload(prev, [rec(90.0)], rec(90.0))
+    out = tune.merge_tune_payload(prev, [rec(90.0)])
     assert out["best"]["tokens_sec_chip"] == 110.0
     assert out["best"]["batch"] == 8
     assert len(out["results"]) == 2
@@ -47,8 +47,7 @@ def test_prior_best_survives_a_worse_run():
 def test_better_run_replaces_best():
     prev = {"backend": "tpu", "best": rec(110.0, batch=8),
             "results": [rec(110.0, batch=8)]}
-    out = tune.merge_tune_payload(prev, [rec(120.0, remat="full")],
-                                  rec(120.0, remat="full"))
+    out = tune.merge_tune_payload(prev, [rec(120.0, remat="full")])
     assert out["best"]["tokens_sec_chip"] == 120.0
     assert out["best"]["remat"] == "full"
 
@@ -56,7 +55,7 @@ def test_better_run_replaces_best():
 def test_remeasured_config_dedupes_latest_wins():
     prev = {"backend": "tpu", "best": rec(95.0),
             "results": [rec(95.0)]}
-    out = tune.merge_tune_payload(prev, [rec(97.0)], rec(97.0))
+    out = tune.merge_tune_payload(prev, [rec(97.0)])
     assert len(out["results"]) == 1
     assert out["results"][0]["tokens_sec_chip"] == 97.0
 
@@ -67,7 +66,7 @@ def test_pre_dimension_records_collapse_onto_defaults():
     old = {"attn": "flash", "batch": 16, "loss_chunk": 256, "heads": 8,
            "dim_head": 64, "tokens_sec_chip": 95.0}
     prev = {"backend": "tpu", "best": old, "results": [old]}
-    out = tune.merge_tune_payload(prev, [rec(96.0)], rec(96.0))
+    out = tune.merge_tune_payload(prev, [rec(96.0)])
     assert len(out["results"]) == 1
     assert out["results"][0]["tokens_sec_chip"] == 96.0
 
@@ -75,7 +74,7 @@ def test_pre_dimension_records_collapse_onto_defaults():
 def test_off_backend_payload_is_discarded():
     prev = {"backend": "cpu", "best": rec(9e9),
             "results": [rec(9e9)]}
-    out = tune.merge_tune_payload(prev, [rec(90.0)], rec(90.0))
+    out = tune.merge_tune_payload(prev, [rec(90.0)])
     assert out["best"]["tokens_sec_chip"] == 90.0
     assert len(out["results"]) == 1
 
@@ -83,10 +82,28 @@ def test_off_backend_payload_is_discarded():
 def test_remeasured_best_corrects_downward():
     # a noisy prior best is retired when the SAME config re-measures lower
     prev = {"backend": "tpu", "best": rec(95.0), "results": [rec(95.0)]}
-    out = tune.merge_tune_payload(prev, [rec(90.0)], rec(90.0))
+    out = tune.merge_tune_payload(prev, [rec(90.0)])
     assert out["best"]["tokens_sec_chip"] == 90.0
 
 
 def test_non_dict_prev_payload_is_discarded():
-    out = tune.merge_tune_payload([], [rec(90.0)], rec(90.0))
+    out = tune.merge_tune_payload([], [rec(90.0)])
     assert out["best"]["tokens_sec_chip"] == 90.0
+
+
+def test_write_merged_incremental(tmp_path):
+    """_write_merged is called after EVERY measured point (a mid-sweep
+    wedge must not cost the points already banked): successive calls
+    accumulate records and keep the best monotone."""
+    import json
+    out = str(tmp_path / "TUNE_NORTH.json")
+    tune._write_merged([rec(100.0)], out=out)
+    tune._write_merged([rec(100.0), rec(90.0, batch=32)], out=out)
+    d = json.load(open(out))
+    assert d["best"]["tokens_sec_chip"] == 100.0
+    assert len(d["results"]) == 2
+    # a later, better run replaces the best; earlier records survive
+    tune._write_merged([rec(120.0, batch=4)], out=out)
+    d = json.load(open(out))
+    assert d["best"]["tokens_sec_chip"] == 120.0
+    assert len(d["results"]) == 3
