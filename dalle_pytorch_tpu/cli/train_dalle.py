@@ -28,6 +28,7 @@ Run: python -m dalle_pytorch_tpu.cli.train_dalle --dataPath ./imagedata \
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import functools
 import os
 
@@ -116,6 +117,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stream the CE head over sequence chunks of this "
                         "size (0 = dense); caps logits memory at "
                         "(batch, chunk, vocab)")
+    p.add_argument("--remat", default="none",
+                   choices=["none", "dots", "full"],
+                   help="rematerialize the scanned layer body in backward: "
+                        "'dots' recomputes only vector work (matmul outputs "
+                        "stay saved, ~2/3 of activation bytes reclaimed at "
+                        "near-zero FLOP cost), 'full' recomputes the whole "
+                        "body (~1/3 more FLOPs, near-zero saved "
+                        "activations) — the levers that let batches beyond "
+                        "16 fit one 16G chip (docs/ANALYSIS_NORTH.md)")
     p.set_defaults(name="test")
     return p
 
@@ -140,7 +150,8 @@ def main(argv=None):
         sparse_attn=sparse, attn_impl=args.attn_impl,
         attn_bwd_impl=args.attn_bwd_impl,
         moe_experts=args.moe_experts, moe_k=args.moe_k,
-        sparse_impl=args.sparse_impl, loss_chunk=args.loss_chunk)
+        sparse_impl=args.sparse_impl, loss_chunk=args.loss_chunk,
+        remat=args.remat)
 
     key = jax.random.PRNGKey(args.seed)
     optimizer = optax.adam(args.lr)
@@ -154,6 +165,11 @@ def main(argv=None):
                                            start_epoch)
         params, opt_state, manifest = ckpt.restore_train(path, optimizer)
         cfg = ckpt.dalle_config_from_manifest(manifest)
+        # remat is a pure execution/memory knob (no effect on params or
+        # numerics — tests/test_transformer.py grad parity), so the CLI
+        # value applies on resume too: resuming at a bigger batch with
+        # --remat full is exactly the advertised use
+        cfg = dataclasses.replace(cfg, remat=args.remat)
         say(f"resumed DALLE from {path}")
     else:
         # ties image_emb to the VAE codebook (reference dalle_pytorch.py:283)

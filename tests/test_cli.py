@@ -314,6 +314,30 @@ class TestTrainDALLEMoE:
         assert epoch == 0
 
 
+class TestTrainDALLERemat:
+    def test_remat_full_trains_and_checkpoints(self, workdir):
+        """--remat full: the rematerialized layer body trains end-to-end
+        through the CLI (the batch-unlocking lever, ANALYSIS_NORTH.md)."""
+        require_ckpt(workdir, "vae", 2)
+        from dalle_pytorch_tpu.cli.train_dalle import main
+        main([
+            "--dataPath", str(workdir / "imagedata"),
+            "--imageSize", str(IMG), "--batchSize", "8",
+            "--captions_only", str(workdir / "only.txt"),
+            "--captions", str(workdir / "pairs.txt"),
+            "--vaename", "vae", "--vae_epoch", "2",
+            "--name", "remattoy", "--n_epochs", "1",
+            "--dim", "16", "--depth", "2", "--heads", "4",
+            "--dim_head", "4", "--num_text_tokens", "50",
+            "--text_seq_len", "8", "--remat", "full",
+            "--lr", "1e-3", "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+            "--log_interval", "1", "--sample_every", "100",
+        ])
+        path, epoch = ckpt.latest(str(workdir / "models"), "remattoy_dalle")
+        assert epoch == 0
+
+
 class TestTrainDALLEPipelineParallel:
     def test_pp_train_runs_and_checkpoints(self, workdir):
         """--pp 4 on the 8-device CPU mesh: dp=2 x pp=4, one layer per
