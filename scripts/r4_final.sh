@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Round-4 closing agenda: the window-4 micro-sweep, then a full-bench
+# re-record (the 04:19 mid-run wedge killed the last one after five
+# configs had measured) plus a fresh kernel/sync smoke papertrail.
+# Safe to launch any time:
+#   nohup bash scripts/r4_final.sh > /tmp/r4_final.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+. scripts/window_lib.sh
+
+# never compete with the window-2/3 chain for the chip or the single CPU
+# core (the etiquette in .claude/skills/verify/SKILL.md) — wait it out
+while pgrep -f 'r4_window[23]\.sh' > /dev/null; do
+  echo "[$(stamp)] window-2/3 chain still running; waiting 120s"
+  sleep 120
+done
+
+start_ts=$(date +%s)
+bash scripts/r4_window4.sh
+
+# window4's step 4 already re-records the bench when its sweep improved
+# the tuned best; only run the closing bench if that didn't happen
+# (healthy windows are 17-35 min — don't spend one on a duplicate pass)
+newest=$(ls -t docs/BENCH_TPU_*.json 2>/dev/null | head -1)
+if [ -n "$newest" ] && \
+   [ "$(stat -c %Y "$newest")" -ge "$start_ts" ]; then
+  echo "[$(stamp)] window-4 already recorded $newest; skipping the closing bench"
+else
+  wait_healthy_tunnel
+  echo "[$(stamp)] == closing full bench =="
+  run_full_bench final
+fi
+
+echo "[$(stamp)] == closing tpu_smoke =="
+bash scripts/tpu_smoke.sh && echo "[$(stamp)] smoke OK" \
+  || echo "[$(stamp)] smoke FAILED"
+echo "[$(stamp)] round-4 closing agenda complete — inspect and commit"
