@@ -84,6 +84,47 @@ def add_common_args(parser: argparse.ArgumentParser,
                         help="enable jax NaN/Inf trapping (slow)")
     parser.add_argument("--metrics", type=str, default="",
                         help="JSONL metrics file path")
+    parser.add_argument("--lr_schedule", default="constant",
+                        choices=["constant", "cosine"],
+                        help="learning-rate schedule (the reference trains "
+                             "at fixed-LR Adam only); 'cosine' decays from "
+                             "--lr to --lr*--lr_end_ratio over the "
+                             "requested run")
+    parser.add_argument("--warmup_steps", type=int, default=0,
+                        help="linear LR warmup from 0 over this many steps")
+    parser.add_argument("--decay_steps", type=int, default=0,
+                        help="cosine decay horizon in steps (0 = the full "
+                             "requested run: n_epochs x steps/epoch)")
+    parser.add_argument("--lr_end_ratio", type=float, default=0.1,
+                        help="cosine floor as a fraction of --lr")
+
+
+def make_optimizer(args, steps_per_epoch: int = 0, start_epoch: int = 0):
+    """optax.adam under the requested LR schedule (add_common_args flags).
+
+    The schedule rides the optimizer's step count, which is part of the
+    checkpointed opt state — a resumed run continues the schedule where it
+    left off, provided the same flags are passed. The default cosine
+    horizon covers the WHOLE run including already-completed epochs
+    (``(start_epoch + n_epochs) * steps_per_epoch``), so callers must
+    resolve the resume epoch before building the optimizer; an explicit
+    ``--decay_steps`` overrides. The reference has no equivalent
+    (fixed-LR Adam: trainVAE.py:69, trainDALLE.py:166)."""
+    import optax
+    if args.lr_schedule == "constant" and not args.warmup_steps:
+        return optax.adam(args.lr)
+    if args.lr_schedule == "constant":
+        sched = optax.linear_schedule(0.0, args.lr, args.warmup_steps)
+    else:
+        decay = args.decay_steps or max(
+            (start_epoch + args.n_epochs) * steps_per_epoch
+            - args.warmup_steps, 1)
+        sched = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=args.lr,
+            warmup_steps=args.warmup_steps,
+            decay_steps=args.warmup_steps + decay,
+            end_value=args.lr * args.lr_end_ratio)
+    return optax.adam(sched)
 
 
 def load_caption_dataset(args):

@@ -22,12 +22,12 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from dalle_pytorch_tpu import checkpoint as ckpt
 from dalle_pytorch_tpu.cli.common import (add_common_args,
                                           load_caption_dataset,
-                                          resolve_resume, say, setup_run)
+                                          make_optimizer, resolve_resume,
+                                          say, setup_run)
 from dalle_pytorch_tpu.data import load_image_batch, prefetch
 from dalle_pytorch_tpu.models import clip as C
 from dalle_pytorch_tpu.parallel import make_train_step, shard_batch
@@ -81,17 +81,27 @@ def main(argv=None):
         visual_patch_size=args.visual_patch_size,
         sparse_attn=not args.dense)
 
+    # data first: the cosine schedule's default horizon is the requested
+    # run length, n_epochs x steps/epoch
+    vocab, dataset = load_caption_dataset(args)
+
     key = jax.random.PRNGKey(args.seed)
-    optimizer = optax.adam(args.lr)
 
     start_epoch = args.start_epoch
-    opt_state = None
+    resume_path = None
     if args.load_clip:
-        path, start_epoch = resolve_resume(args.load_clip, args.models_dir,
-                                           start_epoch)
-        params, opt_state, manifest = ckpt.restore_train(path, optimizer)
+        # resolve the resume epoch BEFORE building the optimizer: the
+        # cosine horizon must cover already-completed epochs too
+        resume_path, start_epoch = resolve_resume(
+            args.load_clip, args.models_dir, start_epoch)
+    optimizer = make_optimizer(args, steps_per_epoch=len(dataset),
+                               start_epoch=start_epoch)
+    opt_state = None
+    if resume_path:
+        params, opt_state, manifest = ckpt.restore_train(resume_path,
+                                                         optimizer)
         cfg = C.CLIPConfig(**manifest["config"])
-        say(f"resumed CLIP from {path}")
+        say(f"resumed CLIP from {resume_path}")
     else:
         params = C.clip_init(key, cfg, dtype=jnp.dtype(args.param_dtype))
 
@@ -99,8 +109,6 @@ def main(argv=None):
                                       opt_state=opt_state)
     step = make_train_step(clip_loss_fn(cfg), optimizer,
                            grad_accum=args.grad_accum)
-
-    vocab, dataset = load_caption_dataset(args)
 
     def load_batch(item):
         paths, toks = item

@@ -35,12 +35,12 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from dalle_pytorch_tpu import checkpoint as ckpt
 from dalle_pytorch_tpu.cli.common import (add_common_args,
                                           load_caption_dataset,
-                                          resolve_resume, say, setup_run)
+                                          make_optimizer, resolve_resume,
+                                          say, setup_run)
 from dalle_pytorch_tpu.data import (load_image_batch, prefetch,
                                     save_image_grid)
 from dalle_pytorch_tpu.models import dalle as D
@@ -153,24 +153,34 @@ def main(argv=None):
         sparse_impl=args.sparse_impl, loss_chunk=args.loss_chunk,
         remat=args.remat)
 
+    # data first: the cosine schedule's default horizon is the requested
+    # run length, n_epochs x steps/epoch
+    vocab, dataset = load_caption_dataset(args)
+
     key = jax.random.PRNGKey(args.seed)
-    optimizer = optax.adam(args.lr)
 
     start_epoch = args.start_epoch
-    opt_state = None
+    resume_path = None
     if args.load_dalle:
+        # resolve the resume epoch BEFORE building the optimizer: the
+        # cosine horizon must cover already-completed epochs too
         name = args.load_dalle if os.path.isdir(args.load_dalle) \
             else f"{args.load_dalle}_dalle"
-        path, start_epoch = resolve_resume(name, args.models_dir,
-                                           start_epoch)
-        params, opt_state, manifest = ckpt.restore_train(path, optimizer)
+        resume_path, start_epoch = resolve_resume(name, args.models_dir,
+                                                  start_epoch)
+    optimizer = make_optimizer(args, steps_per_epoch=len(dataset),
+                               start_epoch=start_epoch)
+    opt_state = None
+    if resume_path:
+        params, opt_state, manifest = ckpt.restore_train(resume_path,
+                                                         optimizer)
         cfg = ckpt.dalle_config_from_manifest(manifest)
         # remat is a pure execution/memory knob (no effect on params or
         # numerics — tests/test_transformer.py grad parity), so the CLI
         # value applies on resume too: resuming at a bigger batch with
         # --remat full is exactly the advertised use
         cfg = dataclasses.replace(cfg, remat=args.remat)
-        say(f"resumed DALLE from {path}")
+        say(f"resumed DALLE from {resume_path}")
     else:
         # ties image_emb to the VAE codebook (reference dalle_pytorch.py:283)
         params = D.dalle_init(key, cfg, vae_params=vae_params,
@@ -189,8 +199,6 @@ def main(argv=None):
                                       opt_state=opt_state)
 
     # -- data --------------------------------------------------------------
-    vocab, dataset = load_caption_dataset(args)
-
     tokenize = jax.jit(functools.partial(V.get_codebook_indices, vae_params))
 
     def load_batch(item):

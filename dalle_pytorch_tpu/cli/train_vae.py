@@ -32,8 +32,8 @@ import numpy as np
 import optax
 
 from dalle_pytorch_tpu import checkpoint as ckpt
-from dalle_pytorch_tpu.cli.common import (add_common_args, resolve_resume,
-                                          say, setup_run)
+from dalle_pytorch_tpu.cli.common import (add_common_args, make_optimizer,
+                                          resolve_resume, say, setup_run)
 from dalle_pytorch_tpu.data import ImageFolderDataset, prefetch, \
     save_image_grid, shard_for_host
 from dalle_pytorch_tpu.models import vae as V
@@ -119,19 +119,31 @@ def main(argv=None):
         hidden_dim=args.hidden_dim, temperature=args.temperature,
         straight_through=args.straight_through)
 
+    dataset = ImageFolderDataset(args.dataPath, args.imageSize,
+                                 args.batchSize, shuffle=True,
+                                 seed=args.seed)
+    # multi-host: each process reads its slice of the files
+    dataset.files = list(shard_for_host(dataset.files))
+
     key = jax.random.PRNGKey(args.seed)
-    optimizer = optax.adam(args.lr)
 
     temperature = args.temperature
     start_epoch = args.start_epoch
-    opt_state = None
+    resume_path = None
     if args.loadVAE:
-        path, start_epoch = resolve_resume(args.loadVAE, args.models_dir,
-                                           start_epoch)
-        params, opt_state, manifest = ckpt.restore_train(path, optimizer)
+        # resolve the resume epoch BEFORE building the optimizer: the
+        # cosine horizon must cover already-completed epochs too
+        resume_path, start_epoch = resolve_resume(
+            args.loadVAE, args.models_dir, start_epoch)
+    optimizer = make_optimizer(args, steps_per_epoch=len(dataset),
+                               start_epoch=start_epoch)
+    opt_state = None
+    if resume_path:
+        params, opt_state, manifest = ckpt.restore_train(resume_path,
+                                                         optimizer)
         cfg = ckpt.vae_config_from_manifest(manifest)
         temperature = manifest["meta"].get("temperature", temperature)
-        say(f"resumed VAE from {path}")
+        say(f"resumed VAE from {resume_path}")
     else:
         params = V.vae_init(key, cfg, dtype=jnp.dtype(args.param_dtype))
 
@@ -139,12 +151,6 @@ def main(argv=None):
                                       opt_state=opt_state)
     step = make_step(cfg, optimizer, args.clip,
                      grad_accum=args.grad_accum)
-
-    dataset = ImageFolderDataset(args.dataPath, args.imageSize,
-                                 args.batchSize, shuffle=True,
-                                 seed=args.seed)
-    # multi-host: each process reads its slice of the files
-    dataset.files = list(shard_for_host(dataset.files))
 
     dk = 0.7 ** (1.0 / max(len(dataset), 1))
     if args.tempsched:

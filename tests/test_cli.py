@@ -242,6 +242,33 @@ class TestParamDtype:
 
 
 @pytest.mark.slow
+class TestLRSchedule:
+    def test_cosine_warmup_trains(self, workdir, tmp_path):
+        """--lr_schedule cosine --warmup_steps: beyond-reference schedule
+        (fixed-LR Adam only, reference trainVAE.py:69) trains and
+        checkpoints; the horizon defaults to the requested run length."""
+        from dalle_pytorch_tpu.cli.train_vae import main
+        main(vae_args(workdir, [
+            "--n_epochs", "1", "--name", "cosvae",
+            "--lr_schedule", "cosine", "--warmup_steps", "2",
+            "--models_dir", str(tmp_path),
+        ]))
+        assert ckpt.latest(str(tmp_path), "cosvae")[1] == 0
+
+    def test_schedule_resumes_from_opt_count(self, workdir, tmp_path):
+        """Resume continues the schedule: the restored opt state carries
+        the step count the schedule rides."""
+        from dalle_pytorch_tpu.cli.train_vae import main
+        sched = ["--lr_schedule", "cosine", "--warmup_steps", "2",
+                 "--models_dir", str(tmp_path)]
+        main(vae_args(workdir, ["--n_epochs", "1", "--name", "cosres"]
+                      + sched))
+        main(vae_args(workdir, ["--n_epochs", "1", "--name", "cosres",
+                                "--loadVAE", "cosres"] + sched))
+        assert ckpt.latest(str(tmp_path), "cosres")[1] == 1
+
+
+@pytest.mark.slow
 class TestTrainDALLESequenceParallel:
     def test_sp_train_runs_and_checkpoints(self, workdir, tmp_path):
         require_ckpt(workdir, "vae", 2)
