@@ -18,18 +18,23 @@ wait_healthy_tunnel() {
   until BENCH_INIT_DEADLINE_S=${BENCH_INIT_DEADLINE_S:-600} \
         python - <<'EOF'
 import os, sys, threading
+# A claim alone is not health: the 2026-07-31 07:16 window claimed fine,
+# then wedged on the first real dispatch. Prove EXECUTION: compile + run
+# a small matmul and fetch the result, all under the same deadline.
 ok = {}
 def probe():
     try:
-        import jax
-        ok["d"] = jax.devices()
+        import jax, jax.numpy as jnp
+        x = jnp.ones((256, 256), jnp.bfloat16)
+        y = jax.jit(lambda a: (a @ a).sum())(x)
+        ok["v"] = float(y)
     except Exception:
         pass
 t = threading.Thread(target=probe, daemon=True)
 t.start()
 t.join(float(os.environ.get("BENCH_INIT_DEADLINE_S", "600")))
 sys.stdout.flush()
-os._exit(0 if "d" in ok else 1)
+os._exit(0 if "v" in ok else 1)
 EOF
   do
     echo "[$(stamp)] still wedged; sleeping 120s"
