@@ -249,6 +249,44 @@ def claim_backend(retries: int, *, attempt_env: str = RETRY_ENV,
     return str(err), attempt + 1
 
 
+# Shared by the measurement scripts (tune_north, longctx_probe): the remote
+# compiler reports HBM exhaustion as an opaque HTTP 500 whose body carries
+# the allocation dump; classify so sweep records read as "didn't fit" vs
+# "broke". One marker list — a new message form lands everywhere at once.
+OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Allocation type", "exceeds the limit",
+               "out of memory")
+
+
+def classify_error_kind(msg: str) -> str:
+    return "oom" if any(m in msg for m in OOM_MARKERS) else "error"
+
+
+def merge_keyed_records(prev_payload, results, key_fn, backend="tpu"):
+    """Latest-wins merge of per-point ``results`` into a previously
+    committed payload's ``results`` list, keyed by ``key_fn``. A payload
+    from a different backend is discarded wholesale (CPU smoke numbers
+    must never sit beside chip numbers). Returns the merged record list;
+    payload assembly (best/sort/extra fields) stays with the caller."""
+    merged = {}
+    if isinstance(prev_payload, dict) and prev_payload.get(
+            "backend") == backend:
+        merged = {key_fn(r): r for r in prev_payload.get("results", [])}
+    merged.update({key_fn(r): r for r in results})       # latest wins
+    return list(merged.values())
+
+
+def atomic_write_json(path: str, obj) -> str:
+    """tmp-write + os.replace — the measurement scripts call this on the
+    per-point hot path and can die at any moment (watchdog os._exit,
+    orchestrator kill); a truncated file would silently wipe the banked
+    record, since every reader treats a JSON error as 'no payload'."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2)
+    os.replace(tmp, path)
+    return path
+
+
 def _load_tune_north():
     """Parsed docs/TUNE_NORTH.json payload, or None. Single loader for the
     two consumers (bench_north's tuned defaults, the stale fallback's

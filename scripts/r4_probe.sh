@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Run the long-context probe after the closing agenda finishes — the
+# probe's committed artifact (docs/LONGCTX.json) is the xla-vs-flash
+# crossover evidence at long sequence lengths. Safe to launch any time:
+#   nohup bash scripts/r4_probe.sh > /tmp/r4_probe.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+. scripts/window_lib.sh
+
+# serialize behind ANY chip-claiming work, not just the closing agenda —
+# and re-check after the tunnel wait, since an agenda may have started
+# while we were blocked in the probe (the residual race is the few
+# seconds between the final check and our own claim)
+chip_busy() {
+  pgrep -f 'scripts/(r4_window[0-9]|r4_closing[0-9]*|r4_final|healthy_window)\.sh|scripts/(tune_north|profile_north)\.py|bench\.py' \
+    > /dev/null
+}
+until ! chip_busy; do
+  echo "[$(stamp)] chip-claiming work still running; waiting 120s"
+  sleep 120
+done
+
+wait_healthy_tunnel
+while chip_busy; do
+  echo "[$(stamp)] an agenda claimed the chip during the wait; waiting 120s"
+  sleep 120
+done
+echo "[$(stamp)] == long-context probe =="
+python scripts/longctx_probe.py --claim_retries 10 \
+  && echo "[$(stamp)] probe OK" || echo "[$(stamp)] probe FAILED"
+echo "[$(stamp)] probe agenda complete — inspect and commit docs/LONGCTX.json"

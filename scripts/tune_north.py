@@ -26,20 +26,16 @@ def cfg_key(r):
 
 
 def merge_tune_payload(prev, results, backend="tpu"):
-    """Fold this run's ``results`` into the previously committed payload.
-    Per-config records dedupe by cfg_key with the latest measurement
-    winning; ``best`` is recomputed over the MERGED set, so a prior winner
-    survives until beaten — but a re-measurement of that same config
-    replaces its number (a noisy best is correctable, never pinned
-    forever). A payload from a different backend is discarded wholesale
-    (CPU smoke numbers must never sit beside chip numbers)."""
-    merged = {}
-    if isinstance(prev, dict) and prev.get("backend") == backend:
-        merged = {cfg_key(r): r for r in prev.get("results", [])}
-    merged.update({cfg_key(r): r for r in results})  # latest wins
-    best = max(merged.values(), key=lambda r: r["tokens_sec_chip"])
-    return {"best": best, "results": list(merged.values()),
-            "backend": backend}
+    """Fold this run's ``results`` into the previously committed payload
+    (bench.merge_keyed_records: latest measurement wins per cfg_key,
+    foreign-backend payloads discarded). ``best`` is recomputed over the
+    MERGED set, so a prior winner survives until beaten — but a
+    re-measurement of that same config replaces its number (a noisy best
+    is correctable, never pinned forever)."""
+    from bench import merge_keyed_records
+    merged = merge_keyed_records(prev, results, cfg_key, backend)
+    best = max(merged, key=lambda r: r["tokens_sec_chip"])
+    return {"best": best, "results": merged, "backend": backend}
 
 
 def _write_merged(results, out=None):
@@ -48,22 +44,14 @@ def _write_merged(results, out=None):
     overrides the destination (tests)."""
     out = out or os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "TUNE_NORTH.json")
+    from bench import atomic_write_json
     prev = None
     try:
         with open(out) as f:
             prev = json.load(f)
     except (OSError, ValueError):
         pass
-    payload = merge_tune_payload(prev, results)
-    # atomic replace: this runs on the per-point hot path and the process
-    # can die at any moment (watchdog os._exit, orchestrator kill) — a
-    # truncated file would silently wipe the whole banked record, since
-    # every reader treats a JSON error as "no payload"
-    tmp = out + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=2)
-    os.replace(tmp, out)
-    return out
+    return atomic_write_json(out, merge_tune_payload(prev, results))
 
 
 def main():
@@ -168,13 +156,7 @@ def main():
                                          args.warmup, args.steps)
             except Exception as e:
                 msg = f"{type(e).__name__}: {e}"
-                # the remote compiler reports HBM exhaustion as an opaque
-                # HTTP 500 whose body carries the allocation dump; classify
-                # so the sweep record reads as "didn't fit" vs "broke"
-                oom_markers = ("RESOURCE_EXHAUSTED", "Allocation type",
-                               "exceeds the limit", "out of memory")
-                kind = ("oom" if any(m in msg for m in oom_markers)
-                        else "error")
+                kind = bench.classify_error_kind(msg)
                 print(json.dumps({"attn": attn, "batch": batch,
                                   "heads": heads, "dim_head": dim_head,
                                   "loss_chunk": chunk, "remat": remat,

@@ -1,0 +1,53 @@
+"""Long-context probe (scripts/longctx_probe.py): merge discipline and a
+CPU smoke of the measured point."""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+spec = importlib.util.spec_from_file_location(
+    "longctx_probe", os.path.join(REPO, "scripts", "longctx_probe.py"))
+probe = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(probe)
+
+
+def test_merge_latest_wins_and_sorts():
+    prev = {"backend": "tpu", "results": [
+        {"impl": "xla", "seq": 2560, "depth": 2, "batch": 1,
+         "tokens_sec": 100.0},
+        {"impl": "flash", "seq": 2560, "depth": 2, "batch": 1,
+         "kind": "error", "error": "x"},
+    ]}
+    new = [
+        {"impl": "flash", "seq": 2560, "depth": 2, "batch": 1,
+         "tokens_sec": 200.0},                       # replaces the error
+        {"impl": "flash", "seq": 5120, "depth": 2, "batch": 1,
+         "kind": "oom", "error": "RESOURCE_EXHAUSTED"},
+    ]
+    out = probe.merge_longctx_payload(prev, new)
+    assert out["backend"] == "tpu"
+    assert len(out["results"]) == 3
+    flash_2560 = [r for r in out["results"]
+                  if r["impl"] == "flash" and r["seq"] == 2560][0]
+    assert flash_2560["tokens_sec"] == 200.0 and "kind" not in flash_2560
+    # sorted by (impl, seq) for a stable committed diff
+    assert [r["seq"] for r in out["results"]] == [2560, 5120, 2560]
+
+
+def test_merge_discards_foreign_backend():
+    prev = {"backend": "cpu", "results": [
+        {"impl": "xla", "seq": 2560, "depth": 2, "batch": 1,
+         "tokens_sec": 1.0}]}
+    out = probe.merge_longctx_payload(prev, [
+        {"impl": "xla", "seq": 5120, "depth": 2, "batch": 1,
+         "tokens_sec": 2.0}])
+    assert len(out["results"]) == 1
+    assert out["results"][0]["seq"] == 5120
+
+
+def test_run_point_cpu_smoke():
+    tps = probe.run_point("xla", 128, depth=1, batch=1, steps=2, warmup=1)
+    assert tps > 0
