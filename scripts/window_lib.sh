@@ -15,7 +15,12 @@ stamp() { date -u +"%H:%M:%S"; }
 # without notice.
 wait_healthy_tunnel() {
   echo "[$(stamp)] waiting for a healthy tunnel (probe deadline/try: ${BENCH_INIT_DEADLINE_S:-600}s)"
+  # `timeout` belt over the in-process deadline: when the relay is FULLY
+  # wedged, python blocks at interpreter startup (sitecustomize claim)
+  # before the deadline thread ever starts, and the probe would hang the
+  # orchestrator forever
   until BENCH_INIT_DEADLINE_S=${BENCH_INIT_DEADLINE_S:-600} \
+        timeout -k 30 $(( ${BENCH_INIT_DEADLINE_S:-600} + 60 )) \
         python - <<'EOF'
 import os, sys, threading
 # A claim alone is not health: the 2026-07-31 07:16 window claimed fine,
