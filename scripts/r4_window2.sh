@@ -13,30 +13,10 @@
 #   nohup bash scripts/r4_window2.sh > /tmp/r4_window2.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-stamp() { date -u +"%H:%M:%S"; }
+. scripts/window_lib.sh
 
-echo "[$(stamp)] waiting for a healthy tunnel (10-min probe deadline/try)"
-until BENCH_INIT_DEADLINE_S=${BENCH_INIT_DEADLINE_S:-600} \
-      python - <<'EOF'
-import os, sys, threading
-ok = {}
-def probe():
-    try:
-        import jax
-        ok["d"] = jax.devices()
-    except Exception:
-        pass
-t = threading.Thread(target=probe, daemon=True)
-t.start()
-t.join(float(os.environ.get("BENCH_INIT_DEADLINE_S", "600")))
-sys.stdout.flush()
-os._exit(0 if "d" in ok else 1)
-EOF
-do
-  echo "[$(stamp)] still wedged; sleeping 120s"
-  sleep 120
-done
-echo "[$(stamp)] tunnel healthy — running the window-2 agenda"
+wait_healthy_tunnel
+echo "[$(stamp)] running the window-2 agenda"
 
 echo "[$(stamp)] == 1/4 remat + reversible sweep =="
 # legs sized to known memory behavior (2026-07-31 sweep: un-rematerialized

@@ -1,10 +1,9 @@
 # Shared helpers for the healthy-window orchestrator scripts. Source from
 # a script that already did `cd` to the repo root:
 #   . "$(dirname "$0")/window_lib.sh"
-# (Extracted from the four per-window scripts, which had begun as copies;
-# r4_window2.sh keeps its inline copy only because it was mid-execution
-# when this file landed — bash reads scripts incrementally, so rewriting
-# a running script corrupts it. Fold it in next time it is edited cold.)
+# NEVER edit a script that is currently executing (bash reads scripts
+# incrementally — rewriting one mid-run corrupts it); editing THIS file
+# while sourcing scripts run is safe, since sourcing loads it whole.
 
 stamp() { date -u +"%H:%M:%S"; }
 
