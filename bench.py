@@ -541,8 +541,15 @@ def bench_north(args):
             "refusing to emit (VERDICT r2 guard)")
 
     gen_p50 = gen_ms_tok = None
+    gen_q_p50 = gen_q_ms_tok = None
     if not args.no_gen:
         gen_p50, gen_ms_tok = bench_generate(cfg, params, args)
+        if args.gen_quant:
+            # same sampler, int8-quantized linears + vocab head — the
+            # weight-HBM half of the per-token cost (ops/quant.py)
+            from dalle_pytorch_tpu.models.dalle import quantize_for_decode
+            gen_q_p50, gen_q_ms_tok = bench_generate(
+                cfg, quantize_for_decode(params), args)
 
     out = {
         "metric": ("DALLE train tokens/sec/chip (depth-12 dim-512, seq "
@@ -561,6 +568,9 @@ def bench_north(args):
         "gen_ms_per_token": gen_ms_tok,
         "backend": jax.default_backend(),
     }
+    if gen_q_ms_tok is not None:
+        out["gen_int8_p50_ms"] = gen_q_p50
+        out["gen_int8_ms_per_token"] = gen_q_ms_tok
     if note:
         out["note"] = note
     return out
@@ -938,8 +948,14 @@ def main():
                          "value, else none)")
     ap.add_argument("--no_gen", action="store_true",
                     help="skip the generate-latency half")
+    ap.add_argument("--gen_quant", action="store_true",
+                    help="also time the sampler with int8-quantized "
+                         "linears + vocab head (gen_int8_* fields; "
+                         "ops/quant.py)")
     ap.add_argument("--retries", type=int, default=3)
     args = ap.parse_args()
+    if args.gen_quant and args.no_gen:
+        ap.error("--gen_quant needs the generate half; drop --no_gen")
 
     # --tiny is a CPU smoke run: force the CPU platform in a fresh
     # interpreter with the axon TPU claim disabled (the sitecustomize claim

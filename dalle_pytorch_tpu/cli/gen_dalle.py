@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--clip_name", type=str, default="",
                    help="CLIP checkpoint name for reranking")
     p.add_argument("--clip_epoch", type=int, default=0)
+    p.add_argument("--quantize", choices=("none", "int8"), default="none",
+                   help="int8: quantize the transformer linears + vocab "
+                        "head after restore (halves per-token weight HBM "
+                        "traffic; ops/quant.py)")
     p.add_argument("--seed", type=int, default=0)
     return p
 
@@ -89,6 +93,8 @@ def main(argv=None):
     # traced positions, which needs device arrays
     params = jax.device_put(params)
     vae_params = jax.device_put(vae_params)
+    if args.quantize == "int8":
+        params = D.quantize_for_decode(params)
 
     vocab = load_vocab(args)
     say(args.caption)

@@ -217,6 +217,21 @@ def to_logits(params: dict, h: Array) -> Array:
     return core.linear(params["to_logits"]["proj"], h)
 
 
+def quantize_for_decode(params: dict) -> dict:
+    """Int8-quantize the weight-heavy inference path — the transformer
+    linears and the vocab head (ops.quant docstring has the bandwidth
+    arithmetic). Embedding tables, positional/axial tables, layernorms,
+    and the tied codebook stay in their stored dtype: they are gathered
+    or tiny, and the VAE decode needs the codebook as-is. Inference only
+    (no tangent through int8); quantize after restore, never checkpoint
+    the result."""
+    from dalle_pytorch_tpu.ops import quant
+    out = dict(params)
+    out["transformer"] = quant.quantize_tree_int8(params["transformer"])
+    out["to_logits"] = quant.quantize_tree_int8(params["to_logits"])
+    return out
+
+
 # ---------------------------------------------------------------------------
 # forward / loss
 # ---------------------------------------------------------------------------

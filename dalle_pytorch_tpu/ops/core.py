@@ -53,8 +53,18 @@ def linear_init(key: Array, in_dim: int, out_dim: int, *, bias: bool = True,
 
 def linear(params: dict, x: Array) -> Array:
     """y = x @ w (+ b). Keeps the contraction in the input dtype so bf16
-    activations hit the MXU; accumulation dtype is left to XLA (f32 on TPU)."""
-    y = jnp.dot(x, params["w"].astype(x.dtype))
+    activations hit the MXU; accumulation dtype is left to XLA (f32 on TPU).
+
+    Accepts an int8-quantized dict ({"w_q", "scale"} from ops.quant)
+    transparently: XLA reads int8 weights from HBM (half the decode-path
+    traffic) and the per-output-channel scale multiplies the matmul
+    result — exact w.r.t. the quantized weights, since a per-out-channel
+    factor commutes with the contraction."""
+    if "w_q" in params:
+        y = jnp.dot(x, params["w_q"].astype(x.dtype))
+        y = y * params["scale"].astype(x.dtype)
+    else:
+        y = jnp.dot(x, params["w"].astype(x.dtype))
     if "b" in params:
         y = y + params["b"].astype(x.dtype)
     return y

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Measure int8-quantized generation on the chip (bench --config north
+# --gen_quant) after the probe chain finishes. The artifact is named
+# QUANTGEN_* so bench's stale-fallback glob (BENCH_TPU_*) never mistakes
+# this single-config payload for a full bench record.
+#   nohup bash scripts/r4_quantgen.sh > /tmp/r4_quantgen.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+. scripts/window_lib.sh
+
+while pgrep -f 'scripts/r4_(probe|closing2)\.sh' > /dev/null; do
+  echo "[$(stamp)] probe/closing chain still running; waiting 120s"
+  sleep 120
+done
+
+wait_healthy_tunnel
+echo "[$(stamp)] == quantized-gen bench =="
+out="docs/QUANTGEN_TPU_$(date -u +%Y-%m-%d_%H%M).json"
+if python bench.py --config north --gen_quant \
+     > /tmp/quantgen.json 2>/tmp/quantgen.err; then
+  python -c "
+import json
+d = json.load(open('/tmp/quantgen.json'))
+json.dump(d, open('$out', 'w'), indent=2)
+print('wrote $out')" && echo "[$(stamp)] quantgen OK"
+else
+  echo "[$(stamp)] quantgen FAILED"; tail -3 /tmp/quantgen.err
+fi
+echo "[$(stamp)] quantgen agenda complete — inspect and commit"

@@ -151,6 +151,23 @@ class TestTrainDALLE:
                 if f.startswith("gendalletoy_epoch_0-")]
         assert outs, "gen_dalle wrote no PNG"
 
+    def test_gen_dalle_quantized(self, workdir):
+        """--quantize int8 runs the same sampler on int8 linears
+        (ops/quant.py) and still writes a grid."""
+        require_ckpt(workdir, "toy_dalle", 0)
+        from dalle_pytorch_tpu.cli.gen_dalle import main
+        before = set(os.listdir(workdir / "results"))
+        main([
+            "a red square",
+            "--name", "toy", "--dalle_epoch", "0",
+            "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+            "--quantize", "int8",
+        ])
+        new = set(os.listdir(workdir / "results")) - before
+        assert any(f.startswith("gendalletoy_epoch_0-") for f in new), \
+            "quantized gen_dalle wrote no PNG"
+
     def test_gen_dalle_clip_rerank(self, workdir):
         require_ckpt(workdir, "toy_dalle", 0)
         """--clip_name reranks the jitted sampler's output (reference

@@ -1,0 +1,104 @@
+"""Int8 weight quantization (ops/quant.py): rounding bound, linear
+equivalence, tree hygiene, and the quantized DALLE decode path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.models import dalle as D
+from dalle_pytorch_tpu.models import vae as V
+from dalle_pytorch_tpu.ops import core, quant
+
+VCFG = V.VAEConfig(image_size=32, num_tokens=48, codebook_dim=32,
+                   num_layers=2, hidden_dim=16)
+CFG = D.DALLEConfig(dim=32, depth=2, vae=VCFG, num_text_tokens=100,
+                    text_seq_len=16, heads=2, dim_head=16)
+
+
+def test_quantize_rounding_bound():
+    """Dequantized weights sit within half a scale step of the originals
+    (symmetric round-to-nearest)."""
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 48), jnp.float32)
+    q = quant.quantize_linear_int8({"w": w})
+    w_hat = q["w_q"].astype(jnp.float32) * q["scale"][None, :]
+    err = jnp.abs(w_hat - w)
+    assert float(jnp.max(err - q["scale"][None, :] / 2)) <= 1e-6
+    assert q["w_q"].dtype == jnp.int8
+    assert int(jnp.max(jnp.abs(q["w_q"]))) <= 127
+
+
+def test_quantized_linear_close_and_bias_kept():
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 48), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(2), (48,), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 64), jnp.float32)
+    dense = core.linear({"w": w, "b": b}, x)
+    quantized = core.linear(quant.quantize_linear_int8({"w": w, "b": b}), x)
+    rel = float(jnp.max(jnp.abs(quantized - dense))
+                / jnp.max(jnp.abs(dense)))
+    assert rel < 0.02
+
+
+def test_quantize_stacked_weights():
+    """Depth-stacked (D, in, out) weights quantize with a (D, out) scale,
+    so the scan's per-layer slices stay consistent."""
+    w = jax.random.normal(jax.random.PRNGKey(4), (3, 16, 8), jnp.float32)
+    q = quant.quantize_linear_int8({"w": w})
+    assert q["w_q"].shape == (3, 16, 8)
+    assert q["scale"].shape == (3, 8)
+    # slicing layer 1 equals quantizing layer 1 alone
+    alone = quant.quantize_linear_int8({"w": w[1]})
+    np.testing.assert_array_equal(np.asarray(q["w_q"][1]),
+                                  np.asarray(alone["w_q"]))
+
+
+def test_tree_quantizes_linears_only():
+    tree = {"ln": {"g": jnp.ones((4,)), "b": jnp.zeros((4,))},
+            "proj": {"w": jnp.ones((4, 4))},
+            "moe_stack": jnp.ones((2, 4, 4))}       # raw array: untouched
+    out = quant.quantize_tree_int8(tree)
+    assert "w_q" in out["proj"] and "w" not in out["proj"]
+    assert "g" in out["ln"]
+    assert out["moe_stack"].dtype == jnp.float32
+
+
+def test_quantize_for_decode_keeps_embeddings():
+    key = jax.random.PRNGKey(0)
+    vae_params = V.vae_init(jax.random.fold_in(key, 1), VCFG)
+    params = D.dalle_init(key, CFG, vae_params)
+    qp = D.quantize_for_decode(params)
+    # embeddings still gatherable; transformer linears quantized
+    assert "w" in qp["text_emb"] and "w" in qp["image_emb"]
+    flat = jax.tree.leaves(
+        jax.tree.map(lambda x: x.dtype == jnp.int8, qp["transformer"]))
+    assert any(flat), "no transformer weight was quantized"
+    assert qp["to_logits"]["proj"]["w_q"].dtype == jnp.int8
+
+
+def test_quantized_forward_close():
+    """Teacher-forced logits with quantized weights track the dense ones
+    (small model: generous-but-meaningful tolerance on the argmax rate)."""
+    key = jax.random.PRNGKey(0)
+    vae_params = V.vae_init(jax.random.fold_in(key, 1), VCFG)
+    params = D.dalle_init(key, CFG, vae_params)
+    text = jax.random.randint(jax.random.fold_in(key, 2), (2, 16), 3, 100)
+    image = jax.random.uniform(jax.random.fold_in(key, 3), (2, 32, 32, 3),
+                               minval=-1, maxval=1)
+    dense = D.dalle_apply(params, text, image, cfg=CFG,
+                          vae_params=vae_params)
+    q = D.dalle_apply(D.quantize_for_decode(params), text, image, cfg=CFG,
+                      vae_params=vae_params)
+    assert q.shape == dense.shape
+    denom = float(jnp.max(jnp.abs(dense)))
+    assert float(jnp.max(jnp.abs(q - dense))) / denom < 0.05
+
+
+def test_quantized_generation_runs():
+    key = jax.random.PRNGKey(0)
+    vae_params = V.vae_init(jax.random.fold_in(key, 1), VCFG)
+    params = D.quantize_for_decode(D.dalle_init(key, CFG, vae_params))
+    text = jax.random.randint(jax.random.fold_in(key, 2), (1, 5), 3, 100)
+    imgs = D.generate_images(params, vae_params, text, cfg=CFG,
+                             rng=jax.random.fold_in(key, 4))
+    assert imgs.shape == (1, 32, 32, 3)
+    assert bool(jnp.all(jnp.isfinite(imgs)))
