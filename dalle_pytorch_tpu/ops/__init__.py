@@ -6,7 +6,8 @@ for the primitive ops (ops.core), attention in several implementations
 fwd + opt-in Pallas bwd; ops.block_sparse: Pallas block-sparse;
 ops.sparse: dense oracle + exact windowed fast path), the top-k
 Mixture-of-Experts feed-forward (ops.moe, expert axis shardable over
-``ep``), the KV-cache decode engine (ops.decode), and the transformer
+``ep``), the KV-cache decode engine (ops.decode), int8 weight
+quantization for the decode path (ops.quant), and the transformer
 stack (ops.transformer) executed either sequentially via ``lax.scan`` or
 reversibly via a ``jax.custom_vjp`` engine (ops.reversible).
 """
