@@ -22,6 +22,13 @@ def _t(x) -> np.ndarray:
 
 
 def _linear(out: Dict[str, np.ndarray], prefix: str, p: dict) -> None:
+    if "w_q" in p:
+        # guard in the shared walker so EVERY export entry point fails
+        # loudly on a quantized tree, not with a KeyError mid-walk
+        raise ValueError(
+            f"{prefix}: int8-quantized weights (ops.quant) cannot be "
+            "exported — quantization is lossy and inference-only; export "
+            "the checkpointed full-precision params instead")
     out[prefix + ".weight"] = _t(p["w"]).T
     if "b" in p:
         out[prefix + ".bias"] = _t(p["b"])

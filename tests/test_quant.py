@@ -93,6 +93,20 @@ def test_quantized_forward_close():
     assert float(jnp.max(jnp.abs(q - dense))) / denom < 0.05
 
 
+def test_quantized_tree_rejected_by_torch_export():
+    """Quantization is lossy and inference-only; exporting a quantized
+    tree to .pth must fail loudly (in the shared _linear walker, so every
+    export entry point is covered), not KeyError deep in the walk."""
+    from dalle_pytorch_tpu.compat.torch_export import export_transformer
+    from dalle_pytorch_tpu.ops import transformer as T
+    cfg = T.TransformerConfig(dim=16, depth=2, seq_len=8, heads=2,
+                              dim_head=8)
+    p = T.transformer_init(jax.random.PRNGKey(0), cfg)
+    export_transformer(p)                       # dense export works
+    with pytest.raises(ValueError, match="quantized"):
+        export_transformer(quant.quantize_tree_int8(p))
+
+
 def test_quantized_generation_runs():
     key = jax.random.PRNGKey(0)
     vae_params = V.vae_init(jax.random.fold_in(key, 1), VCFG)
