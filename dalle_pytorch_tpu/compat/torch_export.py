@@ -23,8 +23,9 @@ def _t(x) -> np.ndarray:
 
 def _linear(out: Dict[str, np.ndarray], prefix: str, p: dict) -> None:
     if "w_q" in p:
-        # guard in the shared walker so EVERY export entry point fails
-        # loudly on a quantized tree, not with a KeyError mid-walk
+        # covers the quantize_for_decode surface (transformer linears +
+        # vocab head all pass through here); embedding/conv reads on a
+        # broader hand-quantized tree still KeyError — don't do that
         raise ValueError(
             f"{prefix}: int8-quantized weights (ops.quant) cannot be "
             "exported — quantization is lossy and inference-only; export "

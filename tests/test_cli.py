@@ -258,6 +258,62 @@ class TestParamDtype:
         assert all(leaf.dtype == jnp.bfloat16 for leaf in leaves)
 
 
+class TestLRScheduleMath:
+    """make_optimizer's schedule values, independent of any CLI run."""
+
+    @staticmethod
+    def _args(**kw):
+        import argparse
+        base = dict(lr=1e-3, lr_schedule="cosine", warmup_steps=10,
+                    decay_steps=0, lr_end_ratio=0.1, n_epochs=4)
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    @staticmethod
+    def _lr_at(opt, step):
+        """Effective LR at ``step`` read off a single-param update."""
+        import jax.numpy as jnp
+        params = {"w": jnp.zeros(())}
+        state = opt.init(params)
+        # advance the optimizer count to `step`
+        for _ in range(step):
+            _, state = opt.update({"w": jnp.ones(())}, state, params)
+        upd, _ = opt.update({"w": jnp.ones(())}, state, params)
+        # adam update of a constant unit gradient = -lr (bias-corrected
+        # m/sqrt(v) == 1 for every step with a constant gradient)
+        return float(-upd["w"])
+
+    def test_warmup_reaches_peak_and_decays_to_floor(self):
+        from dalle_pytorch_tpu.cli.common import make_optimizer
+        args = self._args()
+        opt = make_optimizer(args, steps_per_epoch=10, start_epoch=0)
+        lr_peak = self._lr_at(opt, 10)        # end of warmup
+        lr_mid = self._lr_at(opt, 25)
+        lr_end = self._lr_at(opt, 40)         # horizon = 4 * 10
+        assert lr_peak == pytest.approx(1e-3, rel=0.05)
+        assert 1e-4 < lr_mid < 1e-3
+        assert lr_end == pytest.approx(1e-4, rel=0.1)   # lr * end_ratio
+
+    def test_resume_extends_horizon(self):
+        """start_epoch shifts the cosine horizon so a resumed run keeps
+        decaying instead of sitting at the floor from step 0."""
+        from dalle_pytorch_tpu.cli.common import make_optimizer
+        args = self._args(warmup_steps=0)
+        fresh = make_optimizer(args, steps_per_epoch=10, start_epoch=0)
+        resumed = make_optimizer(args, steps_per_epoch=10, start_epoch=4)
+        # at optimizer step 40: the fresh horizon (40) is exhausted, the
+        # resumed horizon (80) is mid-decay
+        assert self._lr_at(fresh, 40) == pytest.approx(1e-4, rel=0.1)
+        assert self._lr_at(resumed, 40) > 2e-4
+
+    def test_constant_with_warmup_holds_peak(self):
+        from dalle_pytorch_tpu.cli.common import make_optimizer
+        args = self._args(lr_schedule="constant", warmup_steps=5)
+        opt = make_optimizer(args, steps_per_epoch=10, start_epoch=0)
+        assert self._lr_at(opt, 2) < 1e-3
+        assert self._lr_at(opt, 50) == pytest.approx(1e-3, rel=0.02)
+
+
 @pytest.mark.slow
 class TestLRSchedule:
     def test_cosine_warmup_trains(self, workdir, tmp_path):

@@ -94,9 +94,10 @@ def test_quantized_forward_close():
 
 
 def test_quantized_tree_rejected_by_torch_export():
-    """Quantization is lossy and inference-only; exporting a quantized
-    tree to .pth must fail loudly (in the shared _linear walker, so every
-    export entry point is covered), not KeyError deep in the walk."""
+    """Quantization is lossy and inference-only; exporting a
+    quantize_for_decode tree to .pth must fail loudly (the guard sits in
+    the shared _linear walker, which every quantized linear passes
+    through), not KeyError deep in the walk."""
     from dalle_pytorch_tpu.compat.torch_export import export_transformer
     from dalle_pytorch_tpu.ops import transformer as T
     cfg = T.TransformerConfig(dim=16, depth=2, seq_len=8, heads=2,
