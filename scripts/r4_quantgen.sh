@@ -16,7 +16,7 @@ done
 wait_healthy_tunnel
 echo "[$(stamp)] == quantized-gen bench =="
 out="docs/QUANTGEN_TPU_$(date -u +%Y-%m-%d_%H%M).json"
-if python bench.py --config north --gen_quant \
+if python bench.py --config north --gen_quant --gen_batches 1,4 \
      > /tmp/quantgen.json 2>/tmp/quantgen.err; then
   python -c "
 import json
