@@ -558,8 +558,12 @@ def bench_north(args):
                 elif i == 0:
                     gen_q_p50, gen_q_ms_tok = p50, ms_tok
                 else:
+                    # self-describing throughput: ms_tok is wall-ms per
+                    # DECODE STEP (all b sequences advance together), so
+                    # tokens/sec = b * 1000 / ms_tok
                     gen_extra[f"gen_{prefix}b{b}_p50_ms"] = p50
-                    gen_extra[f"gen_{prefix}b{b}_ms_per_token"] = ms_tok
+                    gen_extra[f"gen_{prefix}b{b}_tokens_per_sec"] = round(
+                        b * 1000.0 / ms_tok, 1)
 
     out = {
         "metric": ("DALLE train tokens/sec/chip (depth-12 dim-512, seq "

@@ -117,3 +117,21 @@ def test_quantized_generation_runs():
                              rng=jax.random.fold_in(key, 4))
     assert imgs.shape == (1, 32, 32, 3)
     assert bool(jnp.all(jnp.isfinite(imgs)))
+
+
+def test_quantized_moe_generation_runs():
+    """Quantization composes with MoE decode: the router (a core.linear
+    dict) quantizes, the expert einsum stacks stay raw — one program."""
+    import dataclasses
+    cfg = dataclasses.replace(CFG, moe_experts=2)
+    key = jax.random.PRNGKey(0)
+    vae_params = V.vae_init(jax.random.fold_in(key, 1), VCFG)
+    params = D.quantize_for_decode(D.dalle_init(key, cfg, vae_params))
+    moe_ff = params["transformer"]["ff"]["moe"]
+    assert moe_ff["router"]["w_q"].dtype == jnp.int8
+    assert moe_ff["w1"].dtype != jnp.int8          # expert stacks raw
+    text = jax.random.randint(jax.random.fold_in(key, 2), (1, 5), 3, 100)
+    imgs = D.generate_images(params, vae_params, text, cfg=cfg,
+                             rng=jax.random.fold_in(key, 4))
+    assert imgs.shape == (1, 32, 32, 3)
+    assert bool(jnp.all(jnp.isfinite(imgs)))
