@@ -146,8 +146,20 @@ def restore_train(path: str, optimizer) -> Tuple[Any, Any, dict]:
     opt_file = os.path.join(path, OPT_STATE)
     if os.path.exists(opt_file):
         with open(opt_file, "rb") as f:
-            opt_state = serialization.from_bytes(optimizer.init(params),
-                                                 f.read())
+            try:
+                opt_state = serialization.from_bytes(
+                    optimizer.init(params), f.read())
+            except (KeyError, ValueError) as e:
+                # flax from_bytes fails with an opaque key/shape mismatch
+                # when the optimizer's state TREE differs from the one
+                # that wrote the checkpoint — e.g. resuming with
+                # --clip_grad_norm toggled (optax.chain adds a state
+                # entry). Same flags must be passed on resume.
+                raise ValueError(
+                    f"optimizer state in {path!r} does not match this "
+                    "run's optimizer — resume with the same "
+                    "optimizer-shaping flags (e.g. --clip_grad_norm) "
+                    f"the checkpoint was written with ({e})") from e
     return params, opt_state, manifest
 
 

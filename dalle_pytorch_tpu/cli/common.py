@@ -97,6 +97,14 @@ def add_common_args(parser: argparse.ArgumentParser,
                              "requested run: n_epochs x steps/epoch)")
     parser.add_argument("--lr_end_ratio", type=float, default=0.1,
                         help="cosine floor as a fraction of --lr")
+    parser.add_argument("--clip_grad_norm", type=float, default=0.0,
+                        help="clip gradients to this global L2 norm before "
+                             "the optimizer update (0 = off); complements "
+                             "the reference's post-update WEIGHT clamp "
+                             "(trainVAE.py --clip), which train_vae also "
+                             "keeps. Changes the optimizer-state shape: "
+                             "pass the same value when resuming a "
+                             "checkpoint")
 
 
 def make_optimizer(args, steps_per_epoch: int = 0, start_epoch: int = 0):
@@ -108,12 +116,13 @@ def make_optimizer(args, steps_per_epoch: int = 0, start_epoch: int = 0):
     horizon covers the WHOLE run including already-completed epochs
     (``(start_epoch + n_epochs) * steps_per_epoch``), so callers must
     resolve the resume epoch before building the optimizer; an explicit
-    ``--decay_steps`` overrides. The reference has no equivalent
-    (fixed-LR Adam: trainVAE.py:69, trainDALLE.py:166)."""
+    ``--decay_steps`` overrides. ``--clip_grad_norm`` chains a global-norm
+    clip before adam. The reference has no equivalent of either
+    (fixed-LR unclipped Adam: trainVAE.py:69, trainDALLE.py:166)."""
     import optax
     if args.lr_schedule == "constant" and not args.warmup_steps:
-        return optax.adam(args.lr)
-    if args.lr_schedule == "constant":
+        sched = args.lr
+    elif args.lr_schedule == "constant":
         sched = optax.linear_schedule(0.0, args.lr, args.warmup_steps)
     else:
         decay = args.decay_steps or max(
@@ -124,6 +133,10 @@ def make_optimizer(args, steps_per_epoch: int = 0, start_epoch: int = 0):
             warmup_steps=args.warmup_steps,
             decay_steps=args.warmup_steps + decay,
             end_value=args.lr * args.lr_end_ratio)
+    clip = getattr(args, "clip_grad_norm", 0.0)
+    if clip and clip > 0:
+        return optax.chain(optax.clip_by_global_norm(clip),
+                           optax.adam(sched))
     return optax.adam(sched)
 
 

@@ -313,6 +313,63 @@ class TestLRScheduleMath:
         assert self._lr_at(opt, 2) < 1e-3
         assert self._lr_at(opt, 50) == pytest.approx(1e-3, rel=0.02)
 
+    def test_clip_grad_norm_chains_and_clips(self):
+        """--clip_grad_norm caps the gradient BEFORE adam's moments.
+        Adam's first step is sign-normalized (update ~ g/|g| for any
+        magnitude), so a one-step comparison cannot see the clip; the
+        second moment CAN — an unclipped 5e6-norm gradient poisons v and
+        collapses the next update toward zero, a clipped one does not."""
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu.cli.common import make_optimizer
+
+        def two_step_second_update(opt):
+            params = {"w": jnp.zeros((2,))}
+            state = opt.init(params)
+            u1, state = opt.update({"w": jnp.array([3e6, 4e6])}, state,
+                                   params)
+            u2, _ = opt.update({"w": jnp.array([0.6, 0.8])}, state, params)
+            return u2["w"]
+
+        clipped = make_optimizer(self._args(lr_schedule="constant",
+                                            warmup_steps=0,
+                                            clip_grad_norm=1.0))
+        plain = make_optimizer(self._args(lr_schedule="constant",
+                                          warmup_steps=0,
+                                          clip_grad_norm=0.0))
+        u2_clip = two_step_second_update(clipped)
+        u2_plain = two_step_second_update(plain)
+        # with the clip, step 2 sees two same-scale gradients -> full
+        # lr-sized update; without it, the 5e6-norm outlier dominates both
+        # moments and drags the next update to ~0.67*lr (adam's bias
+        # correction cancels most but not all of the poisoning). The gap
+        # exists ONLY when the clip is chained.
+        assert float(jnp.abs(u2_clip).min()) > 0.98e-3
+        assert float(jnp.abs(u2_plain).max()) < 0.75e-3
+
+    def test_resume_with_toggled_clip_fails_clearly(self):
+        """Toggling --clip_grad_norm on resume changes the opt-state tree;
+        restore must say which flags to check, not raise a raw flax
+        KeyError (checkpoint.restore_train guard)."""
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu import checkpoint as ckpt_mod
+        from dalle_pytorch_tpu.cli.common import make_optimizer
+        params = {"w": jnp.zeros((2,))}
+        plain = make_optimizer(self._args(lr_schedule="constant",
+                                          warmup_steps=0,
+                                          clip_grad_norm=0.0))
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            path = ckpt_mod.save(f"{d}/ck-0", params,
+                                 opt_state=plain.init(params),
+                                 config={}, meta={})
+            clipped = make_optimizer(self._args(lr_schedule="constant",
+                                                warmup_steps=0,
+                                                clip_grad_norm=1.0))
+            with pytest.raises(ValueError, match="clip_grad_norm"):
+                ckpt_mod.restore_train(path, clipped)
+
 
 @pytest.mark.slow
 class TestLRSchedule:
