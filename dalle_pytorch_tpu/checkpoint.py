@@ -15,6 +15,7 @@ Format (a directory per step/epoch, atomic-rename commit):
                            # extra metadata (temperature schedule state, ...)
         params.msgpack     # flax msgpack of the param pytree (bf16-safe)
         opt_state.msgpack  # optional; restored against optimizer.init(params)
+        ema.msgpack        # optional (--ema_decay); f32 EMA of the params
 
 Pytree leaves round-trip through ``flax.serialization`` msgpack (handles
 dict/list/tuple trees of numpy/jax arrays including bfloat16). Restore pulls
@@ -39,6 +40,7 @@ from flax import serialization
 MANIFEST = "manifest.json"
 PARAMS = "params.msgpack"
 OPT_STATE = "opt_state.msgpack"
+EMA = "ema.msgpack"
 
 
 def _to_host(tree):
@@ -57,8 +59,8 @@ def _config_dict(config: Any) -> Any:
 
 
 def save(path: str, params, *, step: int = 0, config: Any = None,
-         opt_state=None, kind: str = "model", meta: Optional[dict] = None
-         ) -> str:
+         opt_state=None, kind: str = "model", meta: Optional[dict] = None,
+         ema=None) -> str:
     """Write a checkpoint directory atomically (tmp dir + rename), so a
     killed writer never leaves a half-checkpoint that resume would trust.
 
@@ -87,6 +89,9 @@ def save(path: str, params, *, step: int = 0, config: Any = None,
         if opt_state is not None:
             with open(os.path.join(tmp, OPT_STATE), "wb") as f:
                 f.write(serialization.to_bytes(_to_host(opt_state)))
+        if ema is not None:
+            with open(os.path.join(tmp, EMA), "wb") as f:
+                f.write(serialization.msgpack_serialize(_to_host(ema)))
         # swap in with no window where neither old nor new exists: move the
         # old checkpoint aside, rename the new one in, then delete the old
         old = None
@@ -132,6 +137,16 @@ def restore(path: str, opt_target=None) -> Tuple[Any, Any, dict]:
 def restore_params(path: str) -> Tuple[Any, dict]:
     params, _, manifest = restore(path)
     return params, manifest
+
+
+def restore_ema(path: str):
+    """The checkpoint's EMA param tree (f32), or None when the checkpoint
+    was written without ``--ema_decay`` (pre-EMA checkpoints included)."""
+    ema_file = os.path.join(path, EMA)
+    if not os.path.exists(ema_file):
+        return None
+    with open(ema_file, "rb") as f:
+        return serialization.msgpack_restore(f.read())
 
 
 def restore_train(path: str, optimizer) -> Tuple[Any, Any, dict]:

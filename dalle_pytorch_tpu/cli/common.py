@@ -10,6 +10,7 @@ the TPU-era flags (--dp mesh, --profile_dir, --nan_checks, --metrics).
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 
 import jax
@@ -97,6 +98,12 @@ def add_common_args(parser: argparse.ArgumentParser,
                              "requested run: n_epochs x steps/epoch)")
     parser.add_argument("--lr_end_ratio", type=float, default=0.1,
                         help="cosine floor as a fraction of --lr")
+    parser.add_argument("--ema_decay", type=float, default=0.0,
+                        help="keep an exponential moving average of the "
+                             "params at this decay (e.g. 0.999; 0 = off), "
+                             "saved alongside each checkpoint; sample from "
+                             "it with gen_dalle --use_ema. The reference "
+                             "has no EMA")
     parser.add_argument("--clip_grad_norm", type=float, default=0.0,
                         help="clip gradients to this global L2 norm before "
                              "the optimizer update (0 = off); complements "
@@ -138,6 +145,50 @@ def make_optimizer(args, steps_per_epoch: int = 0, start_epoch: int = 0):
         return optax.chain(optax.clip_by_global_norm(clip),
                            optax.adam(sched))
     return optax.adam(sched)
+
+
+def make_ema(args, params, resume_path: str = ""):
+    """(ema_tree | None, jit update fn | None) for ``--ema_decay``.
+
+    The accumulator is float32 regardless of param dtype: at decay 0.999
+    a bfloat16 EMA cannot move (eps ~ 0.008 swallows the (1-d) step).
+    On resume the checkpointed EMA continues; a pre-EMA checkpoint falls
+    back to the current params as the starting average."""
+    if getattr(args, "ema_decay", 0.0) <= 0:
+        return None, None
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu import checkpoint as ckpt
+    ema = ckpt.restore_ema(resume_path) if resume_path else None
+    if ema is None:
+        # copy=True: a same-dtype astype would ALIAS the param buffers,
+        # which the donating train step deletes on its next call
+        ema = jax.tree.map(
+            lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params)
+    else:
+        # follow the params' placement leaf-by-leaf: under a multi-host or
+        # stage-sharded (--pp) mesh a bare device_put would leave the EMA
+        # host-local while the params are global
+        ema = jax.tree.map(
+            lambda e, p: jax.device_put(e, getattr(p, "sharding", None)),
+            ema, params)
+    d = args.ema_decay
+
+    # donate the old EMA: it is dead after `ema = update(ema, params)`,
+    # and without donation every step transiently holds two f32 copies
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(e, p):
+        return jax.tree.map(
+            lambda a, b: d * a + (1.0 - d) * b.astype(jnp.float32), e, p)
+
+    return ema, update
+
+
+def ema_as(ema, params):
+    """Cast an f32 EMA tree to the dtypes of ``params`` for eval/decode."""
+    import jax
+    return jax.tree.map(lambda e, p: e.astype(p.dtype), ema, params)
 
 
 def load_caption_dataset(args):

@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--clip_name", type=str, default="",
                    help="CLIP checkpoint name for reranking")
     p.add_argument("--clip_epoch", type=int, default=0)
+    p.add_argument("--use_ema", action="store_true",
+                   help="sample from the checkpoint's EMA weights "
+                        "(train_dalle --ema_decay); errors if the "
+                        "checkpoint has none")
     p.add_argument("--quantize", choices=("none", "int8"), default="none",
                    help="int8: quantize the transformer linears + vocab "
                         "head after restore (halves per-token weight HBM "
@@ -89,6 +93,15 @@ def main(argv=None):
             f"DALLE checkpoint {dalle_path} does not point at a VAE "
             "checkpoint (meta.vae_checkpoint)")
     vae_params, _ = ckpt.restore_params(vae_path)
+    if args.use_ema:
+        ema = ckpt.restore_ema(dalle_path)
+        if ema is None:
+            raise FileNotFoundError(
+                f"{dalle_path} has no EMA weights — train with "
+                "--ema_decay to sample from an EMA")
+        from dalle_pytorch_tpu.cli.common import ema_as
+        params = ema_as(ema, params)
+        say("sampling from EMA weights")
     # restored trees are host numpy; the scan sampler indexes tables with
     # traced positions, which needs device arrays
     params = jax.device_put(params)

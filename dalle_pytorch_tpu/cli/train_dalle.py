@@ -230,6 +230,8 @@ def main(argv=None):
 
     step = make_train_step(loss_fn, optimizer,
                            grad_accum=args.grad_accum)
+    from dalle_pytorch_tpu.cli.common import make_ema
+    ema, ema_update = make_ema(args, params, resume_path or "")
 
     global_step = 0
     for epoch in range(start_epoch, start_epoch + args.n_epochs):
@@ -244,6 +246,8 @@ def main(argv=None):
             params, opt_state, loss = step(
                 params, opt_state, batch,
                 jax.random.fold_in(key, global_step))
+            if ema is not None:
+                ema = ema_update(ema, params)
             profiler.maybe_stop(global_step)
             metrics.step(global_step, loss, epoch=epoch,
                          units=args.batchSize * cfg.seq_len)
@@ -261,7 +265,8 @@ def main(argv=None):
             params, step=epoch, config=cfg, opt_state=opt_state,
             kind="dalle",
             meta={"epoch": epoch, "avg_loss": avg,
-                  "vae_checkpoint": vae_path, "vocab_words": len(vocab)})
+                  "vae_checkpoint": vae_path, "vocab_words": len(vocab)},
+            ema=ema)
         metrics.event(event="checkpoint", path=path, epoch=epoch,
                       avg_loss=avg)
 

@@ -151,6 +151,8 @@ def main(argv=None):
                                       opt_state=opt_state)
     step = make_step(cfg, optimizer, args.clip,
                      grad_accum=args.grad_accum)
+    from dalle_pytorch_tpu.cli.common import make_ema
+    ema, ema_update = make_ema(args, params, resume_path or "")
 
     dk = 0.7 ** (1.0 / max(len(dataset), 1))
     if args.tempsched:
@@ -176,6 +178,8 @@ def main(argv=None):
             params, opt_state, loss = step(
                 params, opt_state, batch,
                 jax.random.fold_in(key, global_step))
+            if ema is not None:
+                ema = ema_update(ema, params)
             profiler.maybe_stop(global_step)
             metrics.step(global_step, loss, epoch=epoch,
                          units=images.shape[0], unit_name="images")
@@ -212,7 +216,7 @@ def main(argv=None):
             ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
             step=epoch, config=cfg, opt_state=opt_state, kind="vae",
             meta={"temperature": temperature, "epoch": epoch,
-                  "avg_loss": avg})
+                  "avg_loss": avg}, ema=ema)
         metrics.event(event="checkpoint", path=path, epoch=epoch,
                       avg_loss=avg, temperature=temperature)
     profiler.close()

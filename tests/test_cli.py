@@ -151,6 +151,44 @@ class TestTrainDALLE:
                 if f.startswith("gendalletoy_epoch_0-")]
         assert outs, "gen_dalle wrote no PNG"
 
+    def test_ema_train_and_sample(self, workdir):
+        """--ema_decay writes EMA weights with the checkpoint and
+        gen_dalle --use_ema samples from them (beyond-reference)."""
+        require_ckpt(workdir, "vae", 2)
+        from dalle_pytorch_tpu.cli.gen_dalle import main as gen_main
+        from dalle_pytorch_tpu.cli.train_dalle import main as train_main
+        train_main([
+            "--dataPath", str(workdir / "imagedata"),
+            "--imageSize", str(IMG), "--batchSize", "4",
+            "--captions_only", str(workdir / "only.txt"),
+            "--captions", str(workdir / "pairs.txt"),
+            "--vaename", "vae", "--vae_epoch", "2",
+            "--name", "toy_ema", "--n_epochs", "1",
+            "--dim", "16", "--depth", "2", "--heads", "2",
+            "--dim_head", "8", "--num_text_tokens", "50",
+            "--text_seq_len", "8", "--attn_dropout", "0",
+            "--ff_dropout", "0", "--lr", "1e-3",
+            "--ema_decay", "0.99",
+            "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+            "--log_interval", "1", "--dp", "1", "--sample_every", "0",
+        ])
+        path, _ = ckpt.latest(str(workdir / "models"), "toy_ema_dalle")
+        ema = ckpt.restore_ema(path)
+        assert ema is not None
+        import jax.numpy as jnp
+        assert all(leaf.dtype == jnp.float32
+                   for leaf in __import__("jax").tree.leaves(ema))
+        before = set(os.listdir(workdir / "results"))
+        gen_main([
+            "a red square",
+            "--name", "toy_ema", "--dalle_epoch", "0", "--use_ema",
+            "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+        ])
+        new = set(os.listdir(workdir / "results")) - before
+        assert any(f.startswith("gendalletoy_ema_epoch_0-") for f in new)
+
     def test_gen_dalle_quantized(self, workdir):
         """--quantize int8 runs the same sampler on int8 linears
         (ops/quant.py) and still writes a grid."""

@@ -1,0 +1,66 @@
+"""EMA weights (--ema_decay): f32 accumulation, checkpoint round-trip,
+resume continuity, and the gen-side cast."""
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dalle_pytorch_tpu import checkpoint as ckpt  # noqa: E402
+from dalle_pytorch_tpu.cli.common import ema_as, make_ema  # noqa: E402
+
+
+def _args(decay):
+    return argparse.Namespace(ema_decay=decay)
+
+
+def test_ema_moves_despite_bf16_params():
+    """The accumulator must be f32: a bf16 EMA at decay 0.999 cannot move
+    (machine eps swallows the step). Params ARE bf16 here; the EMA still
+    converges toward them."""
+    params = {"w": jnp.full((4,), 2.0, jnp.bfloat16)}
+    ema, update = make_ema(_args(0.999), {"w": jnp.zeros((4,),
+                                                        jnp.bfloat16)})
+    assert ema["w"].dtype == jnp.float32
+    for _ in range(100):
+        ema = update(ema, params)
+    # 1 - 0.999^100 ~ 0.0952 of the way from 0 to 2
+    assert float(ema["w"][0]) == pytest.approx(2 * 0.0952, rel=0.01)
+
+
+def test_ema_off_is_none():
+    ema, update = make_ema(_args(0.0), {"w": jnp.zeros((2,))})
+    assert ema is None and update is None
+
+
+def test_checkpoint_roundtrip_and_resume(tmp_path):
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    ema, update = make_ema(_args(0.9), params)
+    ema = update(ema, {"w": jnp.full((3,), 5.0)})
+    path = ckpt.save(str(tmp_path / "m-0"), params, config={}, ema=ema)
+    # pre-EMA checkpoints return None
+    path2 = ckpt.save(str(tmp_path / "n-0"), params, config={})
+    assert ckpt.restore_ema(path2) is None
+    restored = ckpt.restore_ema(path)
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.asarray(ema["w"]))
+    # resume continues from the restored EMA, not from params
+    ema2, _ = make_ema(_args(0.9), params, resume_path=path)
+    np.testing.assert_allclose(np.asarray(ema2["w"]), np.asarray(ema["w"]))
+
+
+def test_ema_as_casts_to_param_dtypes():
+    params = {"a": jnp.zeros((2,), jnp.bfloat16),
+              "b": jnp.zeros((2,), jnp.int8)}
+    ema = {"a": jnp.ones((2,), jnp.float32),
+           "b": jnp.ones((2,), jnp.float32)}
+    out = ema_as(ema, params)
+    assert out["a"].dtype == jnp.bfloat16
+    assert out["b"].dtype == jnp.int8
