@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dalle_pytorch_tpu import checkpoint as ckpt
-from dalle_pytorch_tpu.cli.common import say
+from dalle_pytorch_tpu.cli.common import ema_as, say
 from dalle_pytorch_tpu.data import (Vocabulary, read_captions_only,
                                     save_image_grid)
 from dalle_pytorch_tpu.models import dalle as D
@@ -61,8 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--clip_epoch", type=int, default=0)
     p.add_argument("--use_ema", action="store_true",
                    help="sample from the checkpoint's EMA weights "
-                        "(train_dalle --ema_decay); errors if the "
-                        "checkpoint has none")
+                        "(train_dalle --ema_decay); errors if the DALLE "
+                        "checkpoint has none. A CLIP rerank checkpoint "
+                        "without EMA falls back to raw weights with a "
+                        "note")
     p.add_argument("--quantize", choices=("none", "int8"), default="none",
                    help="int8: quantize the transformer linears + vocab "
                         "head after restore (halves per-token weight HBM "
@@ -99,7 +101,6 @@ def main(argv=None):
             raise FileNotFoundError(
                 f"{dalle_path} has no EMA weights — train with "
                 "--ema_decay to sample from an EMA")
-        from dalle_pytorch_tpu.cli.common import ema_as
         params = ema_as(ema, params)
         say("sampling from EMA weights")
     # restored trees are host numpy; the scan sampler indexes tables with
@@ -123,6 +124,14 @@ def main(argv=None):
         clip_path = ckpt.ckpt_path(args.models_dir, args.clip_name,
                                    args.clip_epoch)
         clip_params, clip_manifest = ckpt.restore_params(clip_path)
+        if args.use_ema:
+            clip_ema = ckpt.restore_ema(clip_path)
+            if clip_ema is not None:
+                clip_params = ema_as(clip_ema, clip_params)
+                say("reranking with CLIP EMA weights")
+            else:
+                say("note: CLIP checkpoint has no EMA weights; "
+                    "reranking with raw weights")
         from dalle_pytorch_tpu.models.clip import CLIPConfig
         clip_kwargs = {"clip_params": clip_params,
                        "clip_cfg": CLIPConfig(**clip_manifest["config"])}

@@ -25,7 +25,7 @@ import numpy as np
 
 from dalle_pytorch_tpu import checkpoint as ckpt
 from dalle_pytorch_tpu.cli.common import (add_common_args,
-                                          load_caption_dataset,
+                                          load_caption_dataset, make_ema,
                                           make_optimizer, resolve_resume,
                                           say, setup_run)
 from dalle_pytorch_tpu.data import load_image_batch, prefetch
@@ -109,6 +109,7 @@ def main(argv=None):
                                       opt_state=opt_state)
     step = make_train_step(clip_loss_fn(cfg), optimizer,
                            grad_accum=args.grad_accum)
+    ema, ema_update = make_ema(args, params, resume_path or "")
 
     def load_batch(item):
         paths, toks = item
@@ -126,6 +127,8 @@ def main(argv=None):
             params, opt_state, loss = step(
                 params, opt_state, batch,
                 jax.random.fold_in(key, global_step))
+            if ema is not None:
+                ema = ema_update(ema, params)
             profiler.maybe_stop(global_step)
             metrics.step(global_step, loss, epoch=epoch,
                          units=args.batchSize, unit_name="pairs")
@@ -140,7 +143,7 @@ def main(argv=None):
         path = ckpt.save(
             ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
             step=epoch, config=cfg, opt_state=opt_state, kind="clip",
-            meta={"epoch": epoch, "avg_loss": avg})
+            meta={"epoch": epoch, "avg_loss": avg}, ema=ema)
         metrics.event(event="checkpoint", path=path, epoch=epoch,
                       avg_loss=avg)
     profiler.close()

@@ -379,6 +379,18 @@ def generate_images(params: dict, vae_params: dict, text: Array, *,
     (genDALLE's unpadded-prompt mode). With ``clip_params`` the generated
     images are scored by CLIP (reference :354-356).
     """
+    if clip_params is not None and \
+            clip_cfg.num_text_tokens < cfg.num_text_tokens:
+        # an undersized CLIP vocab would make the rerank's embedding
+        # gather go out of range on sampled text ids — which jnp.take
+        # (default mode='fill') turns into NaN latents and NaN scores
+        # with no error. Fail before the expensive sampling scan instead
+        # (config-only check, so eager callers fail fast too).
+        raise ValueError(
+            f"CLIP num_text_tokens ({clip_cfg.num_text_tokens}) < "
+            f"DALLE num_text_tokens ({cfg.num_text_tokens}): the "
+            "rerank would gather out-of-range text ids (NaN scores); "
+            "train CLIP with a vocab covering the DALLE's")
     b, t0 = text.shape
     total_len = cfg.seq_len
     tcfg = cfg.transformer

@@ -38,6 +38,25 @@ def params(key, vae_params):
     return D.dalle_init(key, CFG, vae_params)
 
 
+def test_rerank_rejects_undersized_clip_vocab(key, vae_params, params):
+    """A CLIP vocab smaller than the DALLE's would NaN the rerank scores
+    via an out-of-range gather (XLA fills instead of erroring); the
+    library raises at trace time instead."""
+    from dalle_pytorch_tpu.models import clip as C
+    clip_cfg = C.CLIPConfig(
+        dim_text=16, dim_image=16, dim_latent=16,
+        num_text_tokens=CFG.num_text_tokens // 2,     # undersized
+        text_enc_depth=1, text_seq_len=CFG.text_seq_len, text_heads=2,
+        visual_enc_depth=1, visual_image_size=CFG.vae.image_size,
+        visual_patch_size=8, visual_heads=2)
+    clip_params = C.clip_init(jax.random.fold_in(key, 9), clip_cfg)
+    text = jax.random.randint(jax.random.fold_in(key, 2), (1, 5), 3, 100)
+    with pytest.raises(ValueError, match="num_text_tokens"):
+        D.generate_images(params, vae_params, text, cfg=CFG,
+                          rng=jax.random.fold_in(key, 4),
+                          clip_params=clip_params, clip_cfg=clip_cfg)
+
+
 def _toy_batch(key, b=2):
     kt, ki = jax.random.split(key)
     text = jax.random.randint(kt, (b, CFG.text_seq_len), 0,
