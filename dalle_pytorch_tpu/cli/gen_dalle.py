@@ -52,6 +52,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_images", type=int, default=1,
                    help="images to sample for the caption")
     p.add_argument("--filter_thres", type=float, default=0.5)
+    def _top_p(v):
+        v = float(v)
+        if not 0.0 <= v <= 1.0:
+            raise argparse.ArgumentTypeError(
+                f"--top_p must be in [0, 1], got {v}")
+        return v
+
+    p.add_argument("--top_p", type=_top_p, default=0.0,
+                   help="nucleus sampling: keep the top tokens holding "
+                        "this much probability mass, in (0, 1] "
+                        "(0 = the reference's top-k filter via "
+                        "--filter_thres)")
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--pad_prompt", action="store_true",
                    help="pad the prompt to text_seq_len instead of the "
@@ -147,6 +159,7 @@ def main(argv=None):
                                         "clip_cfg": clip_cfg}
         return D.generate_images(p, vp, t, cfg=cfg, rng=rng,
                                  filter_thres=args.filter_thres,
+                                 top_p=args.top_p,
                                  temperature=args.temperature, **kw)
 
     out = gen(params, vae_params, text, jax.random.PRNGKey(args.seed),

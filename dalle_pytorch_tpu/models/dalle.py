@@ -362,10 +362,34 @@ def top_k_filter(logits: Array, thres: float) -> Array:
     return jnp.where(logits < kth, core.neg_inf(logits.dtype), logits)
 
 
+def top_p_filter(logits: Array, p: float) -> Array:
+    """Nucleus filter (beyond reference — the reference samples top-k
+    only, dalle_pytorch.py:41-47): keep the smallest prefix of
+    descending-probability tokens whose cumulative mass reaches ``p``,
+    -inf the rest. Static-shaped (sort + cumsum), so it jits into the
+    same one-program sampler as the top-k path. Callers must pass
+    TEMPERATURE-SCALED logits: the nucleus is defined on the actual
+    sampling distribution."""
+    if not 0.0 < p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {p}")
+    sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # a token is kept when the mass BEFORE it is still < p, so the argmax
+    # always survives; masked (-inf) tokens carry zero mass and sit at
+    # cum == 1, never kept for p <= 1
+    keep_sorted = (cum - probs) < p
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits,
+                               jnp.inf).astype(logits.dtype),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits < thresh, core.neg_inf(logits.dtype), logits)
+
+
 def generate_images(params: dict, vae_params: dict, text: Array, *,
                     cfg: DALLEConfig, rng: Array,
                     mask: Optional[Array] = None,
                     filter_thres: float = 0.5,
+                    top_p: float = 0.0,
                     temperature: float = 1.0,
                     clip_params: Optional[dict] = None,
                     clip_cfg=None,
@@ -405,8 +429,14 @@ def generate_images(params: dict, vae_params: dict, text: Array, *,
         """Sample the token for position pred_pos from last-row logits."""
         lg = jnp.where(forbidden[pred_pos - 1][None], core.neg_inf(
             logits_row.dtype), logits_row)
-        lg = top_k_filter(lg, filter_thres)
-        raw = jax.random.categorical(key, lg / temperature, axis=-1)
+        # temperature first: the nucleus must hold p mass of the ACTUAL
+        # sampling distribution (top-k is rank-preserving, so the reorder
+        # is behavior-neutral for the reference path). Static python
+        # branch: top_p > 0 selects nucleus, else reference top-k.
+        lg = lg / temperature
+        lg = (top_p_filter(lg, top_p) if top_p > 0
+              else top_k_filter(lg, filter_thres))
+        raw = jax.random.categorical(key, lg, axis=-1)
         is_image = pred_pos >= cfg.text_seq_len
         return jnp.where(is_image, raw - cfg.num_text_tokens, raw)
 
@@ -490,7 +520,8 @@ class DALLE:
 
     def generate_images(self, text: Array, *, rng: Optional[Array] = None,
                         clip=None, mask: Optional[Array] = None,
-                        filter_thres: float = 0.5, temperature: float = 1.0):
+                        filter_thres: float = 0.5, top_p: float = 0.0,
+                        temperature: float = 1.0):
         if rng is None:
             rng = jax.random.PRNGKey(0)
         kwargs = {}
@@ -498,5 +529,5 @@ class DALLE:
             kwargs = {"clip_params": clip.params, "clip_cfg": clip.config}
         return generate_images(self.params, self.vae.params, text,
                                cfg=self.config, rng=rng, mask=mask,
-                               filter_thres=filter_thres,
+                               filter_thres=filter_thres, top_p=top_p,
                                temperature=temperature, **kwargs)

@@ -38,6 +38,47 @@ def params(key, vae_params):
     return D.dalle_init(key, CFG, vae_params)
 
 
+class TestTopP:
+    """Filtered entries are the codebase's neg_inf fill = -finfo.max
+    (reference parity, a FINITE float) — test keep/drop via a threshold,
+    not isfinite."""
+
+    @staticmethod
+    def _kept(out):
+        from dalle_pytorch_tpu.ops import core
+        return (np.asarray(out) > float(core.neg_inf(jnp.float32)) / 2)[0]
+
+    def test_tiny_p_keeps_only_argmax(self):
+        logits = jnp.asarray([[1.0, 3.0, 2.0, -jnp.inf]])
+        out = D.top_p_filter(logits, 1e-6)
+        assert float(out[0, 1]) == 3.0
+        assert self._kept(out).tolist() == [False, True, False, False]
+
+    def test_p_one_keeps_all_unmasked(self):
+        logits = jnp.asarray([[1.0, 3.0, 2.0, -jnp.inf]])
+        out = D.top_p_filter(logits, 1.0)
+        # masked stays dropped
+        assert self._kept(out).tolist() == [True, True, True, False]
+
+    def test_nucleus_cut(self):
+        """p=0.6 over probs [.655,.242,.089,...]: the first token holds
+        .655 >= .6, second starts at cum .655 >= p -> only argmax kept;
+        p=0.7 keeps the first two."""
+        logits = jnp.log(jnp.asarray([[0.655, 0.242, 0.089, 0.014]]))
+        assert self._kept(D.top_p_filter(logits, 0.6)).tolist() == \
+            [True, False, False, False]
+        assert self._kept(D.top_p_filter(logits, 0.7)).tolist() == \
+            [True, True, False, False]
+
+    def test_generation_with_top_p(self, key, vae_params, params):
+        imgs = D.generate_images(params, vae_params,
+                                 jax.random.randint(key, (1, 5), 3, 100),
+                                 cfg=CFG, rng=jax.random.fold_in(key, 4),
+                                 top_p=0.9)
+        assert imgs.shape == (1, 32, 32, 3)
+        assert bool(jnp.all(jnp.isfinite(imgs)))
+
+
 def test_rerank_rejects_undersized_clip_vocab(key, vae_params, params):
     """A CLIP vocab smaller than the DALLE's would NaN the rerank scores
     via an out-of-range gather (XLA fills instead of erroring); the
