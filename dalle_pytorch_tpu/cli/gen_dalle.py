@@ -65,6 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(0 = the reference's top-k filter via "
                         "--filter_thres)")
     p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--guidance", type=float, default=0.0,
+                   help="classifier-free guidance scale (e.g. 3.0; 0 = "
+                        "off, 1.0 = plain conditional): image tokens "
+                        "sample from uncond + s*(cond - uncond), with the "
+                        "all-PAD null caption as the unconditional "
+                        "stream. Train with --caption_drop first")
     p.add_argument("--pad_prompt", action="store_true",
                    help="pad the prompt to text_seq_len instead of the "
                         "reference's unpadded text-completion mode")
@@ -159,7 +165,7 @@ def main(argv=None):
                                         "clip_cfg": clip_cfg}
         return D.generate_images(p, vp, t, cfg=cfg, rng=rng,
                                  filter_thres=args.filter_thres,
-                                 top_p=args.top_p,
+                                 top_p=args.top_p, guidance=args.guidance,
                                  temperature=args.temperature, **kw)
 
     out = gen(params, vae_params, text, jax.random.PRNGKey(args.seed),

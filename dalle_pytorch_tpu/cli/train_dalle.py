@@ -75,6 +75,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dim_head", type=int, default=64)
     p.add_argument("--num_text_tokens", type=int, default=10000)
     p.add_argument("--text_seq_len", type=int, default=256)
+    def _prob(v):
+        v = float(v)
+        if not 0.0 <= v <= 1.0:
+            raise argparse.ArgumentTypeError(
+                f"must be a probability in [0, 1], got {v}")
+        return v
+
+    p.add_argument("--caption_drop", type=_prob, default=0.0,
+                   help="per-sample probability of replacing the caption "
+                        "with the all-PAD null caption during training — "
+                        "enables classifier-free guidance at generation "
+                        "time (gen_dalle --guidance); dense path only")
     p.add_argument("--attn_dropout", type=float, default=0.1)
     p.add_argument("--ff_dropout", type=float, default=0.1)
     p.add_argument("--reversible", action="store_true")
@@ -132,6 +144,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.caption_drop > 0 and (args.sp > 1 or args.pp > 1):
+        raise SystemExit("--caption_drop is supported on the dense path "
+                         "only (not --sp/--pp)")
     mesh, metrics, profiler = setup_run(args)
 
     # -- VAE (frozen tokenizer/decoder) — the cross-CLI contract ----------
@@ -220,11 +235,21 @@ def main(argv=None):
             cfg, mesh, dp_axis="dp",
             num_microbatches=args.pp_microbatches or None)
     else:
+        caption_drop = args.caption_drop
+
         def loss_fn(params, batch, rng):
             # all-True mask, matching the reference's training call
             # (trainDALLE.py:192); image ids are precomputed outside the step
-            mask = jnp.ones_like(batch["text"], bool)
-            return D.dalle_apply(params, batch["text"], batch["image"],
+            text = batch["text"]
+            if caption_drop > 0:
+                # per-sample null caption (all PAD) so the model learns the
+                # unconditional distribution guidance extrapolates against
+                drop = jax.random.bernoulli(
+                    jax.random.fold_in(rng, 0x0CFD),
+                    caption_drop, (text.shape[0], 1))
+                text = jnp.where(drop, 0, text)
+            mask = jnp.ones_like(text, bool)
+            return D.dalle_apply(params, text, batch["image"],
                                  cfg=cfg, mask=mask, rng=rng, train=True,
                                  return_loss=True)
 

@@ -391,6 +391,7 @@ def generate_images(params: dict, vae_params: dict, text: Array, *,
                     filter_thres: float = 0.5,
                     top_p: float = 0.0,
                     temperature: float = 1.0,
+                    guidance: float = 0.0,
                     clip_params: Optional[dict] = None,
                     clip_cfg=None,
                     return_img_seq: bool = False):
@@ -402,6 +403,16 @@ def generate_images(params: dict, vae_params: dict, text: Array, *,
     shorter than text_seq_len are completed through the text span first
     (genDALLE's unpadded-prompt mode). With ``clip_params`` the generated
     images are scored by CLIP (reference :354-356).
+
+    ``guidance`` > 0 enables classifier-free guidance (beyond reference):
+    a second, unconditional stream — the all-PAD null caption — rides in
+    the batch dimension of the SAME one-program scan, and each image
+    token samples from ``l_uncond + guidance * (l_cond - l_uncond)``
+    (guidance 1.0 reduces to conditional sampling). Both streams consume
+    the same sampled image tokens so their KV caches agree; text
+    positions sample from the conditional stream alone while the null
+    stream keeps PAD. Train with ``--caption_drop`` so the model has
+    seen null captions.
     """
     if clip_params is not None and \
             clip_cfg.num_text_tokens < cfg.num_text_tokens:
@@ -419,16 +430,38 @@ def generate_images(params: dict, vae_params: dict, text: Array, *,
     total_len = cfg.seq_len
     tcfg = cfg.transformer
 
+    guided = guidance > 0
+    if guided:
+        # unconditional stream = the all-PAD null caption, batched below
+        # the conditional rows so one scan serves both
+        text = jnp.concatenate([text, jnp.zeros_like(text)], axis=0)
+        if mask is not None:
+            # the null stream gets an all-True mask: --caption_drop
+            # training attends every PAD position of a dropped caption
+            # (loss_fn's all-True mask), and the uncond baseline must
+            # match that distribution
+            mask = jnp.concatenate([mask, jnp.ones_like(mask)], axis=0)
+    rows = text.shape[0]
+
     tokens = embed_prompt(params, cfg, text)
     h, cache = decode_ops.prefill(params["transformer"], tokens, cfg=tcfg,
                                   total_len=total_len, prompt_mask=mask)
-    key_mask = decode_ops._full_key_mask(mask, b, t0, total_len)
+    key_mask = decode_ops._full_key_mask(mask, rows, t0, total_len)
     forbidden = logits_mask(cfg)
+    uncond_rows = jnp.arange(rows) >= b
 
     def sample(logits_row, pred_pos, key):
         """Sample the token for position pred_pos from last-row logits."""
         lg = jnp.where(forbidden[pred_pos - 1][None], core.neg_inf(
             logits_row.dtype), logits_row)
+        is_image = pred_pos >= cfg.text_seq_len
+        if guided:
+            # mix in f32: the forbidden fill is -finfo.max and the
+            # extrapolation below must not overflow it
+            l_c = lg[:b].astype(jnp.float32)
+            l_u = lg[b:].astype(jnp.float32)
+            mix = l_u + guidance * (l_c - l_u)
+            lg = jnp.where(is_image, mix, l_c).astype(lg.dtype)
         # temperature first: the nucleus must hold p mass of the ACTUAL
         # sampling distribution (top-k is rank-preserving, so the reorder
         # is behavior-neutral for the reference path). Static python
@@ -437,7 +470,8 @@ def generate_images(params: dict, vae_params: dict, text: Array, *,
         lg = (top_p_filter(lg, top_p) if top_p > 0
               else top_k_filter(lg, filter_thres))
         raw = jax.random.categorical(key, lg, axis=-1)
-        is_image = pred_pos >= cfg.text_seq_len
+        if guided:
+            raw = jnp.tile(raw, 2)       # both streams take the same token
         return jnp.where(is_image, raw - cfg.num_text_tokens, raw)
 
     # token for position t0 from the prefill's last row
@@ -447,6 +481,10 @@ def generate_images(params: dict, vae_params: dict, text: Array, *,
     def step(carry, pos):
         cur_tok, cache = carry
         is_text = pos < cfg.text_seq_len
+        if guided:
+            # the null stream's text stays PAD — feeding it the sampled
+            # caption would make it conditional
+            cur_tok = jnp.where(is_text & uncond_rows, 0, cur_tok)
         text_e = (jnp.take(params["text_emb"]["w"],
                            jnp.clip(cur_tok, 0, cfg.num_text_tokens - 1),
                            axis=0)
@@ -468,9 +506,9 @@ def generate_images(params: dict, vae_params: dict, text: Array, *,
 
     positions = jnp.arange(t0, total_len)
     (_, _), toks = lax.scan(step, (first_tok, cache), positions)
-    toks = jnp.moveaxis(toks, 0, 1)                     # (b, total_len - t0)
+    toks = jnp.moveaxis(toks, 0, 1)                  # (rows, total_len - t0)
 
-    full = jnp.concatenate([text, toks], axis=1)
+    full = jnp.concatenate([text, toks], axis=1)[:b]   # cond stream only
     img_seq = full[:, -cfg.image_seq_len:]
     images = vae_mod.decode(vae_params, img_seq,
                             codebook=params["image_emb"]["w"])
@@ -521,7 +559,7 @@ class DALLE:
     def generate_images(self, text: Array, *, rng: Optional[Array] = None,
                         clip=None, mask: Optional[Array] = None,
                         filter_thres: float = 0.5, top_p: float = 0.0,
-                        temperature: float = 1.0):
+                        guidance: float = 0.0, temperature: float = 1.0):
         if rng is None:
             rng = jax.random.PRNGKey(0)
         kwargs = {}
@@ -530,4 +568,5 @@ class DALLE:
         return generate_images(self.params, self.vae.params, text,
                                cfg=self.config, rng=rng, mask=mask,
                                filter_thres=filter_thres, top_p=top_p,
+                               guidance=guidance,
                                temperature=temperature, **kwargs)

@@ -189,6 +189,52 @@ class TestTrainDALLE:
         new = set(os.listdir(workdir / "results")) - before
         assert any(f.startswith("gendalletoy_ema_epoch_0-") for f in new)
 
+    def test_caption_drop_and_guided_gen(self, workdir):
+        """--caption_drop trains through null captions; gen_dalle
+        --guidance samples with classifier-free guidance."""
+        require_ckpt(workdir, "vae", 2)
+        from dalle_pytorch_tpu.cli.gen_dalle import main as gen_main
+        from dalle_pytorch_tpu.cli.train_dalle import main as train_main
+        train_main([
+            "--dataPath", str(workdir / "imagedata"),
+            "--imageSize", str(IMG), "--batchSize", "4",
+            "--captions_only", str(workdir / "only.txt"),
+            "--captions", str(workdir / "pairs.txt"),
+            "--vaename", "vae", "--vae_epoch", "2",
+            "--name", "toy_cfg", "--n_epochs", "1",
+            "--dim", "16", "--depth", "2", "--heads", "2",
+            "--dim_head", "8", "--num_text_tokens", "50",
+            "--text_seq_len", "8", "--attn_dropout", "0",
+            "--ff_dropout", "0", "--lr", "1e-3",
+            "--caption_drop", "0.5",
+            "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+            "--log_interval", "1", "--dp", "1", "--sample_every", "0",
+        ])
+        before = set(os.listdir(workdir / "results"))
+        gen_main([
+            "a red square",
+            "--name", "toy_cfg", "--dalle_epoch", "0",
+            "--guidance", "3.0",
+            "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+        ])
+        new = set(os.listdir(workdir / "results")) - before
+        assert any(f.startswith("gendalletoy_cfg_epoch_0-") for f in new)
+
+    def test_caption_drop_rejected_under_sp(self, workdir):
+        from dalle_pytorch_tpu.cli.train_dalle import main as train_main
+        with pytest.raises(SystemExit, match="dense path"):
+            train_main([
+                "--dataPath", str(workdir / "imagedata"),
+                "--captions_only", str(workdir / "only.txt"),
+                "--captions", str(workdir / "pairs.txt"),
+                "--vaename", "vae", "--vae_epoch", "2",
+                "--caption_drop", "0.1", "--sp", "2", "--dp", "1",
+                "--models_dir", str(workdir / "models"),
+                "--results_dir", str(workdir / "results"),
+            ])
+
     def test_gen_dalle_quantized(self, workdir):
         """--quantize int8 runs the same sampler on int8 linears
         (ops/quant.py) and still writes a grid."""

@@ -79,6 +79,34 @@ class TestTopP:
         assert bool(jnp.all(jnp.isfinite(imgs)))
 
 
+class TestGuidance:
+    def test_guidance_one_matches_unguided(self, key, vae_params, params):
+        """s=1.0 reduces the mix to the conditional logits, and the rng
+        key schedule is identical — the guided program must reproduce the
+        unguided samples exactly."""
+        text = jax.random.randint(jax.random.fold_in(key, 2), (2, 5),
+                                  3, 100)
+        plain = D.generate_images(params, vae_params, text, cfg=CFG,
+                                  rng=jax.random.fold_in(key, 4),
+                                  return_img_seq=True)[1]
+        guided = D.generate_images(params, vae_params, text, cfg=CFG,
+                                   rng=jax.random.fold_in(key, 4),
+                                   guidance=1.0, return_img_seq=True)[1]
+        np.testing.assert_array_equal(np.asarray(plain),
+                                      np.asarray(guided))
+
+    def test_guided_generation_runs(self, key, vae_params, params):
+        text = jax.random.randint(jax.random.fold_in(key, 2), (2, 5),
+                                  3, 100)
+        imgs, seq = D.generate_images(params, vae_params, text, cfg=CFG,
+                                      rng=jax.random.fold_in(key, 4),
+                                      guidance=3.0, return_img_seq=True)
+        assert imgs.shape == (2, 32, 32, 3)        # cond stream only
+        assert bool(jnp.all(jnp.isfinite(imgs)))
+        assert int(seq.min()) >= 0
+        assert int(seq.max()) < CFG.num_image_tokens
+
+
 def test_rerank_rejects_undersized_clip_vocab(key, vae_params, params):
     """A CLIP vocab smaller than the DALLE's would NaN the rerank scores
     via an out-of-range gather (XLA fills instead of erroring); the
