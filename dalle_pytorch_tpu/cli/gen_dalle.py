@@ -65,7 +65,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(0 = the reference's top-k filter via "
                         "--filter_thres)")
     p.add_argument("--temperature", type=float, default=1.0)
-    p.add_argument("--guidance", type=float, default=0.0,
+    def _guidance(v):
+        v = float(v)
+        if v < 0:
+            raise argparse.ArgumentTypeError(
+                f"--guidance must be >= 0, got {v}")
+        return v
+
+    p.add_argument("--guidance", type=_guidance, default=0.0,
                    help="classifier-free guidance scale (e.g. 3.0; 0 = "
                         "off, 1.0 = plain conditional): image tokens "
                         "sample from uncond + s*(cond - uncond), with the "
