@@ -177,11 +177,27 @@ def _emit_stale_fallback(failure: dict):
             payload["value"] = best["tokens_sec_chip"]
             payload["vs_baseline"] = round(
                 best["tokens_sec_chip"] / A100_TOKENS_PER_SEC_EST, 3)
-            # carry the sweep point's identity too — the headline number
-            # must not read as the artifact's (different batch/config) run
-            for k in ("mfu", "batch", "loss"):
+            # Every measured field still in the payload belongs to the OLD
+            # artifact's run, not the sweep point now headlining —
+            # namespace everything but the identity/provenance fields
+            # (allow-list, so future artifact fields can't leak through)
+            # and then carry over the sweep point's own values where it
+            # has them (advisor r4).
+            keep = {"metric", "unit", "backend", "value", "vs_baseline",
+                    "stale", "stale_artifact", "stale_reason",
+                    "stale_bench_value", "value_source"}
+            artifact_only = {k: payload.pop(k) for k in list(payload)
+                             if k not in keep}
+            if artifact_only:
+                payload["stale_artifact_fields"] = artifact_only
+            for k in ("mfu", "batch", "loss", "devices"):
                 if k in best:
                     payload[k] = best[k]
+            # the sweep shares the artifact's single-chip methodology;
+            # older sweep records don't carry a devices count of their
+            # own — promote (move, don't copy: one field, one provenance)
+            if "devices" not in payload and "devices" in artifact_only:
+                payload["devices"] = artifact_only.pop("devices")
             payload["metric"] = (
                 "DALLE train tokens/sec/chip (depth-12 dim-512, seq 1280, "
                 f"bf16, attn={best.get('attn', '?')})")

@@ -161,20 +161,31 @@ def restore_train(path: str, optimizer) -> Tuple[Any, Any, dict]:
     opt_file = os.path.join(path, OPT_STATE)
     if os.path.exists(opt_file):
         with open(opt_file, "rb") as f:
-            try:
-                opt_state = serialization.from_bytes(
-                    optimizer.init(params), f.read())
-            except (KeyError, ValueError) as e:
-                # flax from_bytes fails with an opaque key/shape mismatch
-                # when the optimizer's state TREE differs from the one
-                # that wrote the checkpoint — e.g. resuming with
-                # --clip_grad_norm toggled (optax.chain adds a state
-                # entry). Same flags must be passed on resume.
-                raise ValueError(
-                    f"optimizer state in {path!r} does not match this "
-                    "run's optimizer — resume with the same "
-                    "optimizer-shaping flags (e.g. --clip_grad_norm) "
-                    f"the checkpoint was written with ({e})") from e
+            data = f.read()
+        # decode in two steps so a corrupt/truncated file is not
+        # misdiagnosed as a flag mismatch: msgpack_restore fails only on
+        # bad bytes; from_state_dict fails only on tree-structure mismatch
+        try:
+            state_dict = serialization.msgpack_restore(data)
+        except Exception as e:
+            raise ValueError(
+                f"optimizer state file {opt_file!r} is corrupt or "
+                f"truncated — cannot decode its msgpack payload ({e}); "
+                "restore from an older checkpoint or retrain") from e
+        try:
+            opt_state = serialization.from_state_dict(
+                optimizer.init(params), state_dict)
+        except (KeyError, ValueError) as e:
+            # an opaque key/shape mismatch here means the optimizer's
+            # state TREE differs from the one that wrote the checkpoint —
+            # e.g. resuming with --clip_grad_norm toggled (optax.chain
+            # adds a state entry). Same flags must be passed on resume.
+            raise ValueError(
+                f"optimizer state in {path!r} does not match this "
+                "run's optimizer — resume with the same "
+                "optimizer-shaping flags (e.g. --clip_grad_norm) "
+                "the checkpoint was written with, or the file is from "
+                f"an incompatible version ({e})") from e
     return params, opt_state, manifest
 
 

@@ -102,8 +102,10 @@ def add_common_args(parser: argparse.ArgumentParser,
                         help="keep an exponential moving average of the "
                              "params at this decay (e.g. 0.999; 0 = off), "
                              "saved alongside each checkpoint; sample from "
-                             "it with gen_dalle --use_ema. The reference "
-                             "has no EMA")
+                             "it with gen_dalle --use_ema. Resuming a "
+                             "checkpoint that carries an EMA requires the "
+                             "flag again (pass -1 to discard the EMA on "
+                             "purpose). The reference has no EMA")
     parser.add_argument("--clip_grad_norm", type=float, default=0.0,
                         help="clip gradients to this global L2 norm before "
                              "the optimizer update (0 = off); complements "
@@ -155,12 +157,38 @@ def make_ema(args, params, resume_path: str = ""):
     On resume the checkpointed EMA continues; a pre-EMA checkpoint falls
     back to the current params as the starting average."""
     if getattr(args, "ema_decay", 0.0) <= 0:
+        # resuming a checkpoint THAT HAS an EMA without --ema_decay would
+        # silently drop it: the next save writes no ema.msgpack and the
+        # accumulated average is gone for good. Refuse; discarding must be
+        # explicit (--ema_decay -1).
+        if resume_path and os.path.exists(
+                os.path.join(resume_path, ckpt.EMA)):
+            if getattr(args, "ema_decay", 0.0) < 0:
+                say(f"warning: discarding the EMA in {resume_path!r} "
+                    "(--ema_decay < 0)")
+            else:
+                raise SystemExit(
+                    f"checkpoint {resume_path!r} carries an EMA but "
+                    "--ema_decay was not given — resuming would silently "
+                    "drop the accumulated average. Pass the original "
+                    "--ema_decay to continue it, or --ema_decay -1 to "
+                    "discard it on purpose.")
         return None, None
     import jax
     import jax.numpy as jnp
 
-    from dalle_pytorch_tpu import checkpoint as ckpt
     ema = ckpt.restore_ema(resume_path) if resume_path else None
+    if resume_path:
+        # a changed decay on resume is legal (e.g. tightening late in the
+        # run) but must not pass silently — the average's horizon changes
+        try:
+            prev = ckpt.load_manifest(resume_path).get(
+                "meta", {}).get("ema_decay")
+        except Exception:
+            prev = None
+        if prev is not None and abs(prev - args.ema_decay) > 1e-12:
+            say(f"warning: resume checkpoint was written with --ema_decay "
+                f"{prev}; continuing with {args.ema_decay}")
     if ema is None:
         # copy=True: a same-dtype astype would ALIAS the param buffers,
         # which the donating train step deletes on its next call

@@ -143,7 +143,9 @@ def main(argv=None):
         path = ckpt.save(
             ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
             step=epoch, config=cfg, opt_state=opt_state, kind="clip",
-            meta={"epoch": epoch, "avg_loss": avg}, ema=ema)
+            meta={"epoch": epoch, "avg_loss": avg,
+                  **({"ema_decay": args.ema_decay} if ema is not None
+                     else {})}, ema=ema)
         metrics.event(event="checkpoint", path=path, epoch=epoch,
                       avg_loss=avg)
     profiler.close()

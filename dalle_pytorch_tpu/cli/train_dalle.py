@@ -290,7 +290,9 @@ def main(argv=None):
             params, step=epoch, config=cfg, opt_state=opt_state,
             kind="dalle",
             meta={"epoch": epoch, "avg_loss": avg,
-                  "vae_checkpoint": vae_path, "vocab_words": len(vocab)},
+                  "vae_checkpoint": vae_path, "vocab_words": len(vocab),
+                  **({"ema_decay": args.ema_decay} if ema is not None
+                     else {})},
             ema=ema)
         metrics.event(event="checkpoint", path=path, epoch=epoch,
                       avg_loss=avg)

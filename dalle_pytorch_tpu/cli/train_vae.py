@@ -216,7 +216,9 @@ def main(argv=None):
             ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
             step=epoch, config=cfg, opt_state=opt_state, kind="vae",
             meta={"temperature": temperature, "epoch": epoch,
-                  "avg_loss": avg}, ema=ema)
+                  "avg_loss": avg,
+                  **({"ema_decay": args.ema_decay} if ema is not None
+                     else {})}, ema=ema)
         metrics.event(event="checkpoint", path=path, epoch=epoch,
                       avg_loss=avg, temperature=temperature)
     profiler.close()

@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Round-5 banking agenda, priority-ordered per VERDICT r4 "Next round":
+#   1. full bench at tuned defaults  -> docs/BENCH_TPU_<ts>.json  (item 1:
+#      the rc=0 artifact every perf claim should route through)
+#   2. long-context probe            -> docs/LONGCTX.json         (item 4:
+#      the flash kernel's memory-crossover existence proof)
+#   3. int8 quantized generation     -> docs/QUANTGEN_TPU_*.json  (item 5)
+#   4. MFU micro-sweeps (batch 4/6, heads 4x128, loss_chunk 128/512,
+#      flash tiles)                  -> docs/TUNE_NORTH.json      (item 2)
+#   5. conditional re-bench if the sweeps moved the tuned best
+# Every leg is independent (|| continues); artifacts merge incrementally,
+# so a window that closes mid-chain still banks whatever finished.
+# Launch any time (waits for a healthy tunnel itself):
+#   nohup bash scripts/r5_agenda.sh > /tmp/r5_agenda.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+. scripts/window_lib.sh
+
+wait_healthy_tunnel
+echo "[$(stamp)] == 1/5 full bench (tuned defaults) =="
+run_full_bench r5
+
+echo "[$(stamp)] == 2/5 long-context probe =="
+python scripts/longctx_probe.py --seqs 2560,5120,10240 \
+  && echo "[$(stamp)] longctx OK" || echo "[$(stamp)] longctx FAILED"
+
+echo "[$(stamp)] == 3/5 quantized generation =="
+out="docs/QUANTGEN_TPU_$(date -u +%Y-%m-%d_%H%M).json"
+if python bench.py --config north --gen_quant --gen_batches 1,4 \
+     > /tmp/r5_quantgen.json 2>/tmp/r5_quantgen.err; then
+  python -c "
+import json
+d = json.load(open('/tmp/r5_quantgen.json'))
+json.dump(d, open('$out', 'w'), indent=2)
+print('wrote $out')" && echo "[$(stamp)] quantgen OK"
+else
+  echo "[$(stamp)] quantgen FAILED"; tail -3 /tmp/r5_quantgen.err
+fi
+
+best_before=$(tuned_best)
+echo "[$(stamp)] == 4/5 micro-sweeps (best so far: $best_before) =="
+python scripts/tune_north.py --attns flash --batches 4,6 \
+  --loss_chunks 256 --claim_retries 3 \
+  && echo "[$(stamp)] small-batch leg OK" \
+  || echo "[$(stamp)] small-batch leg FAILED"
+python scripts/tune_north.py --attns flash,xla --batches 8 \
+  --loss_chunks 256 --head_cfgs 4x128 --claim_retries 3 \
+  && echo "[$(stamp)] head-split leg OK" \
+  || echo "[$(stamp)] head-split leg FAILED"
+python scripts/tune_north.py --attns flash --batches 8 \
+  --loss_chunks 128,512 --claim_retries 3 \
+  && echo "[$(stamp)] loss-chunk leg OK" \
+  || echo "[$(stamp)] loss-chunk leg FAILED"
+python scripts/tune_north.py --attns flash --batches 8 \
+  --loss_chunks 256 --flash_blocks 256x256,128x256,256x128,640x128 \
+  --claim_retries 3 \
+  && echo "[$(stamp)] tile sweep OK" || echo "[$(stamp)] tile sweep FAILED"
+
+echo "[$(stamp)] == 5/5 conditional re-bench =="
+rebench_if_improved "$best_before" r5b
+echo "[$(stamp)] r5 banking agenda complete — inspect and commit"

@@ -17,9 +17,14 @@ wait_healthy_tunnel() {
   # `timeout` belt over the in-process deadline: when the relay is FULLY
   # wedged, python blocks at interpreter startup (sitecustomize claim)
   # before the deadline thread ever starts, and the probe would hang the
-  # orchestrator forever
+  # orchestrator forever.
+  # BENCH_INIT_DEADLINE_S is a float elsewhere (bench.py float()s it;
+  # tests export 0.01) — truncate before the integer shell arithmetic or
+  # the probe command itself errors and the loop spins forever.
+  local deadline_int
+  deadline_int=$(printf '%.0f' "${BENCH_INIT_DEADLINE_S:-600}")
   until BENCH_INIT_DEADLINE_S=${BENCH_INIT_DEADLINE_S:-600} \
-        timeout -k 30 $(( ${BENCH_INIT_DEADLINE_S:-600} + 60 )) \
+        timeout -k 30 $(( deadline_int + 60 )) \
         python - <<'EOF'
 import os, sys, threading
 # A claim alone is not health: the 2026-07-31 07:16 window claimed fine,

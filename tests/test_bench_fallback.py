@@ -109,6 +109,13 @@ def test_stale_fallback_surfaces_tuned_best():
     assert d["batch"] == best["batch"]
     assert d["loss"] == best["loss"]
     assert best.get("attn", "?") in d["metric"]
+    # the artifact's OWN measured perf fields must not sit at top level
+    # where they'd read as the tuned config's numbers (advisor r4):
+    # they move under stale_artifact_fields
+    for k in ("gen_p50_ms", "gen_ms_per_token", "step_ms"):
+        assert k not in d, k
+    assert any(k in d.get("stale_artifact_fields", {})
+               for k in ("gen_p50_ms", "gen_ms_per_token"))
 
 
 def test_wedged_tunnel_emits_stale_fallback():

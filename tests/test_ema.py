@@ -56,6 +56,55 @@ def test_checkpoint_roundtrip_and_resume(tmp_path):
     np.testing.assert_allclose(np.asarray(ema2["w"]), np.asarray(ema["w"]))
 
 
+def test_resume_with_ema_but_no_decay_refuses(tmp_path):
+    """Resuming a checkpoint that carries an EMA without --ema_decay must
+    refuse, not silently drop the accumulated average (advisor r4): the
+    next save would write no ema.msgpack and the average is gone."""
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    ema, _ = make_ema(_args(0.9), params)
+    path = ckpt.save(str(tmp_path / "m-0"), params, config={}, ema=ema)
+    with pytest.raises(SystemExit, match="carries an EMA"):
+        make_ema(_args(0.0), params, resume_path=path)
+    # explicit negative decay = discard on purpose, allowed
+    ema2, upd2 = make_ema(_args(-1.0), params, resume_path=path)
+    assert ema2 is None and upd2 is None
+    # a pre-EMA checkpoint never triggers the guard
+    plain = ckpt.save(str(tmp_path / "p-0"), params, config={})
+    ema3, upd3 = make_ema(_args(0.0), params, resume_path=plain)
+    assert ema3 is None and upd3 is None
+
+
+def test_resume_with_changed_decay_warns(tmp_path, capsys):
+    """The manifest records the decay the EMA was written with; resuming
+    with a different value is legal but surfaced."""
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    ema, _ = make_ema(_args(0.9), params)
+    path = ckpt.save(str(tmp_path / "m-0"), params, config={}, ema=ema,
+                     meta={"ema_decay": 0.9})
+    make_ema(_args(0.99), params, resume_path=path)
+    assert "ema_decay 0.9" in capsys.readouterr().out
+    # same decay: silent
+    make_ema(_args(0.9), params, resume_path=path)
+    assert "ema_decay" not in capsys.readouterr().out
+
+
+def test_corrupt_opt_state_diagnosed(tmp_path):
+    """A truncated opt_state.msgpack must be reported as corruption, not
+    as an optimizer-shaping-flags mismatch (advisor r4)."""
+    import optax
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    opt = optax.adam(1e-3)
+    path = ckpt.save(str(tmp_path / "m-0"), params, config={},
+                     opt_state=opt.init(params))
+    opt_file = os.path.join(path, ckpt.OPT_STATE)
+    with open(opt_file, "rb") as f:
+        data = f.read()
+    with open(opt_file, "wb") as f:
+        f.write(data[:7])  # truncate mid-header
+    with pytest.raises(ValueError, match="corrupt or truncated"):
+        ckpt.restore_train(path, opt)
+
+
 def test_ema_as_casts_to_param_dtypes():
     params = {"a": jnp.zeros((2,), jnp.bfloat16),
               "b": jnp.zeros((2,), jnp.int8)}
