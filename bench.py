@@ -51,9 +51,15 @@ import time
 import traceback
 
 A100_TOKENS_PER_SEC_EST = 2.9e5
+A100_BF16_PEAK = 312e12     # A100 dense bf16 TFLOPs (baseline estimates)
+A100_MFU_EST = 0.40         # assumed A100 training MFU for the estimates
 BF16_PEAK = {          # per-chip dense bf16 TFLOPs
     "v5e": 197e12, "v5litepod": 197e12, "v4": 275e12, "v5p": 459e12,
     "v6e": 918e12,
+}
+HBM_BW = {             # per-chip HBM bytes/sec (decode roofline)
+    "v5e": 819e9, "v5litepod": 819e9, "v4": 1228e9, "v5p": 2765e9,
+    "v6e": 1640e9,
 }
 RETRY_ENV = "BENCH_ATTEMPT"
 
@@ -355,6 +361,14 @@ def _bf16_peak():
     return BF16_PEAK["v5e"]
 
 
+def _hbm_bw():
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    for k, v in HBM_BW.items():
+        if gen.startswith(k):
+            return v
+    return HBM_BW["v5e"]
+
+
 def _fetch(x) -> float:
     """Host round-trip on one element of ``x`` — the only reliable sync on
     this platform (block_until_ready returns early; see module docstring
@@ -369,17 +383,103 @@ def _fetch(x) -> float:
 # ---------------------------------------------------------------------------
 
 def dalle_train_flops_per_token(cfg) -> float:
-    """Matmul + attention FLOPs per sequence token for one fwd+bwd step."""
+    """Matmul + attention FLOPs per sequence token for one fwd+bwd step.
+
+    Sparse-pattern aware (conservatively): attention FLOPs are counted
+    ONLY on dense layers — sparse layers' windowed/block attention is
+    treated as free, so the A100 baseline estimate derived from this
+    count is as FAST as the real reference could plausibly be, and the
+    resulting ``vs_baseline`` never flatters this repo."""
     d, L, n = cfg.dim, cfg.depth, cfg.seq_len
     dh = cfg.heads * cfg.dim_head
     hidden = d * 4                                  # GEGLU ff_mult default
     per_layer = 2 * (d * 3 * dh + dh * d            # qkv + out proj
                      + d * hidden * 2 + hidden * d)  # GEGLU w1 (x2) + w2
     attn = 2 * (2 * n * dh)                          # qk^T + av, per token
+    try:                      # DALLEConfig carries it via .transformer
+        pattern = cfg.transformer.sparse_pattern
+    except AttributeError:
+        pattern = getattr(cfg, "sparse_pattern", (False,) * L)
+    dense_layers = sum(1 for s in pattern if not s)
     logits = 2 * d * cfg.total_tokens
     embed = 0                                        # gather, not matmul
-    fwd = L * (per_layer + attn) + logits + embed
+    fwd = L * per_layer + dense_layers * attn + logits + embed
     return 3.0 * fwd                                 # fwd + 2x bwd
+
+
+def a100_tokens_per_sec_est(cfg) -> float:
+    """Estimated A100 tokens/sec/chip for the SAME model: analytic
+    fwd+bwd FLOPs at 40% MFU of A100's 312 bf16 TFLOPs — the methodology
+    behind A100_TOKENS_PER_SEC_EST (2.9e5 = this formula on the north
+    config), generalized so every train config gets a vs_baseline
+    (VERDICT r4 item 8). The reference publishes no numbers
+    (BASELINE.md), so an analytic estimate is the only available bar."""
+    return A100_MFU_EST * A100_BF16_PEAK / dalle_train_flops_per_token(cfg)
+
+
+def vae_train_flops_per_image(cfg) -> float:
+    """Analytic conv-matmul FLOPs per image for one DiscreteVAE fwd+bwd
+    step (models/vae.py structure: n stride-2 4x4 enc convs, 1x1 logits
+    head, codebook mix, mirrored transpose decoder, 1x1 out). A conv is
+    2 * out_pixels * k^2 * cin * cout FLOPs; a stride-2 transpose conv
+    touches each INPUT pixel k^2 * cout times. Resnet blocks add two 3x3
+    and one 1x1 at constant resolution."""
+    n, h, c = cfg.num_layers, cfg.hidden_dim, cfg.channels
+    s = cfg.image_size
+    fwd = 0.0
+    # encoder: stride-2 4x4 convs, cin -> cout at halved resolution
+    enc_chans = [c] + [h] * n
+    res = s
+    for cin, cout in zip(enc_chans[:-1], enc_chans[1:]):
+        res //= 2
+        fwd += 2 * res * res * 16 * cin * cout
+    grid = cfg.grid_size
+    fwd += 2 * grid * grid * enc_chans[-1] * cfg.num_tokens   # 1x1 logits
+    fwd += 2 * grid * grid * cfg.num_tokens * cfg.codebook_dim  # mix
+    # resnet blocks (enc + dec): two 3x3 + one 1x1 at constant res
+    res_flops = 2 * grid * grid * (9 + 9 + 1) * h * h
+    fwd += 2 * cfg.num_resnet_blocks * res_flops
+    # decoder: mirrored stride-2 4x4 transpose convs
+    dec_in = h if cfg.num_resnet_blocks else cfg.codebook_dim
+    if cfg.num_resnet_blocks:
+        fwd += 2 * grid * grid * cfg.codebook_dim * h         # 1x1 stem
+    dec_chans = [dec_in] + [h] * (n - 1)
+    res = grid
+    for cin in dec_chans:
+        fwd += 2 * res * res * 16 * cin * h
+        res *= 2
+    fwd += 2 * s * s * h * c                                  # 1x1 out
+    return 3.0 * fwd                                          # fwd + 2x bwd
+
+
+def a100_images_per_sec_est(cfg) -> float:
+    """A100 images/sec estimate for the VAE config — same methodology as
+    a100_tokens_per_sec_est (analytic FLOPs at 40% MFU of 312 TFLOPs)."""
+    return A100_MFU_EST * A100_BF16_PEAK / vae_train_flops_per_image(cfg)
+
+
+def decode_roofline_ms_per_token(cfg, quantize: str = "none",
+                                 batch: int = 1) -> float:
+    """HBM-bandwidth floor for one KV-cache decode step: every step
+    re-reads the full matmul weight set (the transformer linears + the
+    vocab head — the embedding tables are gathers reading one row each,
+    so they are NOT streamed and don't count) and each sequence's KV
+    cache; at small batch the matmuls are matrix-vector, so bytes — not
+    FLOPs — bound the step. This finishes the ops/quant.py arithmetic
+    (VERDICT r4 item 8): the measured gen_ms_per_token should be judged
+    against THIS number, and int8 weights halve only the weight-bytes
+    share. ``batch`` scales the per-sequence KV reads (weights amortize
+    across the batch within one step)."""
+    d, L = cfg.dim, cfg.depth
+    dh = cfg.heads * cfg.dim_head
+    hidden = d * 4
+    per_layer = d * 3 * dh + dh * d + d * hidden * 2 + hidden * d \
+        + 4 * d                                     # qkv,out,GEGLU,2 LN
+    head = d * cfg.total_tokens
+    wbytes_per_param = 1 if quantize == "int8" else 2
+    weight_bytes = (L * per_layer + head) * wbytes_per_param
+    kv_bytes = batch * 2 * L * cfg.seq_len * dh * 2  # K+V, bf16, full cache
+    return (weight_bytes + kv_bytes) / _hbm_bw() * 1e3
 
 
 # ---------------------------------------------------------------------------
@@ -404,9 +504,9 @@ def build_cfg(tiny: bool, depth: int = 12, reversible: bool = False,
 
     # unknown strings would otherwise silently run un-rematerialized under
     # a wrong label (the transformer validates too; fail before compiling)
-    if remat not in ("none", "dots", "full"):
-        raise ValueError(
-            f"remat must be 'none', 'dots' or 'full', got {remat!r}")
+    if remat not in ("none", "save_ln", "dots", "full"):
+        raise ValueError(f"remat must be 'none', 'save_ln', 'dots' or "
+                         f"'full', got {remat!r}")
 
     # 'flash_pallas' = flash forward + the Pallas backward kernels
     attn_bwd = "xla"
@@ -514,7 +614,7 @@ def bench_north(args):
     if remat is None:
         remat = tuned.get("remat") or "none"
     reversible = bool(tuned.get("reversible", False))
-    if reversible and args.remat in ("dots", "full"):
+    if reversible and args.remat in ("save_ln", "dots", "full"):
         # explicit flags win: the reversible engine ignores cfg.remat
         # (transformer.py reversible branch), so honoring an explicit
         # remat request means dropping the tuned engine choice
@@ -602,9 +702,23 @@ def bench_north(args):
         # headline gen_* fields are historically batch-1; mark a deviation
         # so records stay comparable
         out["gen_batch"] = args.gen_batches[0]
+    if gen_ms_tok is not None and jax.default_backend() == "tpu":
+        # judge the decode against its HBM-bandwidth floor (the per-token
+        # cost is weight+cache reads, not FLOPs — see the roofline fn);
+        # the floor is computed at the HEADLINE batch so the two sides of
+        # the fraction describe the same program
+        gb = args.gen_batches[0]
+        floor = decode_roofline_ms_per_token(cfg, batch=gb)
+        out["gen_roofline_ms_per_token"] = round(floor, 4)
+        out["gen_roofline_frac"] = round(floor / gen_ms_tok, 3)
     if gen_q_ms_tok is not None:
         out["gen_int8_p50_ms"] = gen_q_p50
         out["gen_int8_ms_per_token"] = gen_q_ms_tok
+        if jax.default_backend() == "tpu":
+            q_floor = decode_roofline_ms_per_token(
+                cfg, quantize="int8", batch=args.gen_batches[0])
+            out["gen_int8_roofline_ms_per_token"] = round(q_floor, 4)
+            out["gen_int8_roofline_frac"] = round(q_floor / gen_q_ms_tok, 3)
     out.update(gen_extra)
     if note:
         out["note"] = note
@@ -709,7 +823,11 @@ def bench_vae(args):
         "metric": "DiscreteVAE train images/sec/chip (256px, 3-layer, 2048 "
                   "tokens)" if not args.tiny else "tiny vae images/sec/chip",
         "value": round(ips, 2), "unit": "images/sec/chip",
-        "vs_baseline": None, "loss": round(loss, 4), "batch": batch,
+        # same methodology as the north number: analytic fwd+bwd FLOPs at
+        # an assumed 40% MFU on A100 (VERDICT r4 item 8 — no more nulls)
+        "vs_baseline": round(ips / a100_images_per_sec_est(cfg), 3),
+        "a100_images_per_sec_est": round(a100_images_per_sec_est(cfg), 1),
+        "loss": round(loss, 4), "batch": batch,
         "devices": n_dev, "backend": jax.default_backend(),
     }
 
@@ -792,7 +910,12 @@ def bench_sparse(args):
         "metric": "DALLE depth-64 block-sparse train tokens/sec/chip "
                   "(windowed fast path)" if not args.tiny else "tiny sparse",
         "value": round(results["windowed"], 1), "unit": "tokens/sec/chip",
-        "vs_baseline": None,
+        # analytic depth-64 FLOPs (attention counted on dense layers only
+        # — conservative: treats the reference's DeepSpeed sparse layers
+        # as free) at 40% A100 MFU, same methodology as the north number
+        "vs_baseline": round(results["windowed"]
+                             / a100_tokens_per_sec_est(cfg), 3),
+        "a100_tokens_per_sec_est": round(a100_tokens_per_sec_est(cfg), 1),
         "windowed_vs_ref_speedup": round(
             results["windowed"] / results["ref"], 3),
         "pallas_vs_ref_speedup": round(results["pallas"] / results["ref"],
@@ -981,9 +1104,10 @@ def main():
                          "(0 = dense; default: the committed tuned value, "
                          "else dense)")
     ap.add_argument("--remat", default=None,
-                    choices=["none", "dots", "full"],
+                    choices=["none", "save_ln", "dots", "full"],
                     help="layer-body rematerialization for the north config "
-                         "('dots' = recompute vector work only, matmul "
+                         "('save_ln' = drop only the f32 layernorm saves; "
+                         "'dots' = recompute vector work only, matmul "
                          "outputs stay saved; default: the committed tuned "
                          "value, else none)")
     ap.add_argument("--no_gen", action="store_true",

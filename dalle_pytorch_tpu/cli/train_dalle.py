@@ -130,8 +130,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "size (0 = dense); caps logits memory at "
                         "(batch, chunk, vocab)")
     p.add_argument("--remat", default="none",
-                   choices=["none", "dots", "full"],
+                   choices=["none", "save_ln", "dots", "full"],
                    help="rematerialize the scanned layer body in backward: "
+                        "'save_ln' drops only the f32 layernorm saves "
+                        "(cheapest recompute for the bytes that drive OOM), "
                         "'dots' recomputes only vector work (matmul outputs "
                         "stay saved, ~2/3 of activation bytes reclaimed at "
                         "near-zero FLOP cost), 'full' recomputes the whole "

@@ -54,6 +54,29 @@ def _structural(rows, cols, *, block, window, global_blocks, causal):
     return allow
 
 
+def _static_tile_schedule(block_q, block_k, block, window, global_blocks,
+                          causal):
+    """The default VariableSparsity layout admits a STATIC k-tile schedule:
+    when q and k tiles are the same size and the local window divides the
+    tile, every row of q-tile ``iq`` finds its whole local window inside
+    k-tile ``iq``; if additionally each global block sits wholly inside
+    one statically-known k-tile, the complete schedule is
+    ``{global tiles} + {diagonal}`` — no scan over tiles, no per-tile
+    ``lax.cond`` predication (the r4-measured loss vs the XLA oracle was
+    exactly that loop overhead: 10 causal tiles scanned to execute 2).
+    Returns the sorted global-tile list, or None when the layout doesn't
+    admit the static schedule (fall back to the scanning kernel)."""
+    if block_q != block_k or block_k % window != 0 or not causal:
+        return None
+    tiles = set()
+    for g in global_blocks:
+        lo, hi = g * block, g * block + block - 1
+        if lo // block_k != hi // block_k:
+            return None                   # global block straddles tiles
+        tiles.add(lo // block_k)
+    return sorted(tiles)
+
+
 def _kernel(*refs, scale, causal, block_q, block_k, seq_len, has_mask, block,
             window, global_blocks):
     if has_mask:
@@ -67,58 +90,81 @@ def _kernel(*refs, scale, causal, block_q, block_k, seq_len, has_mask, block,
     rows = iq * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, 1), 0)                       # (BQ, 1)
 
-    num_k = pl.cdiv(seq_len, block_k)
-    if causal:
-        num_k = jnp.minimum(num_k, pl.cdiv((iq + 1) * block_q, block_k))
+    def update(ik, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(ik * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(ik * block_k, block_k), :]
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) \
+            * scale
+        cols = ik * block_k + lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)               # (1, BK)
+        if has_mask:
+            km = mk_ref[0, :1, pl.ds(ik * block_k, block_k)] != 0
+            s = jnp.where(km, s, FILL)        # keys only (reference)
+        struct = _structural(rows, cols, block=block, window=window,
+                             global_blocks=global_blocks, causal=causal)
+        if seq_len % block_k:             # ragged tail tile bounds
+            struct = struct & (cols < seq_len)
+        s = jnp.where(struct, s, -jnp.inf)
 
-    w_lo_q = (iq * block_q) // window
-    w_hi_q = (iq * block_q + block_q - 1) // window
-
-    def tile_any(ik):
-        w_lo_k = (ik * block_k) // window
-        w_hi_k = (ik * block_k + block_k - 1) // window
-        overlap = (w_lo_k <= w_hi_q) & (w_lo_q <= w_hi_k)
-        for g in global_blocks:
-            tok = g * block
-            overlap = overlap | ((tok >= ik * block_k)
-                                 & (tok < (ik + 1) * block_k))
-        return overlap
-
-    def body(ik, carry):
-        def update(carry):
-            m, l, acc = carry
-            kb = k_ref[0, pl.ds(ik * block_k, block_k), :]
-            vb = v_ref[0, pl.ds(ik * block_k, block_k), :]
-            s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32) \
-                * scale
-            cols = ik * block_k + lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)               # (1, BK)
-            if has_mask:
-                km = mk_ref[0, :1, pl.ds(ik * block_k, block_k)] != 0
-                s = jnp.where(km, s, FILL)        # keys only (reference)
-            struct = _structural(rows, cols, block=block, window=window,
-                                 global_blocks=global_blocks, causal=causal)
-            if seq_len % block_k:             # ragged tail tile bounds
-                struct = struct & (cols < seq_len)
-            s = jnp.where(struct, s, -jnp.inf)
-
-            m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-            shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-            p = jnp.where(jnp.isfinite(s), jnp.exp(s - shift), 0.0)
-            alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
-            l = l * alpha + p.sum(axis=-1, keepdims=True)
-            acc = acc * alpha + jax.lax.dot_general(
-                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            return m_new, l, acc
-
-        return lax.cond(tile_any(ik), update, lambda c: c, carry)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - shift), 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
 
     m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     a0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
-    m, l, acc = lax.fori_loop(0, num_k, body, (m0, l0, a0))
+    carry0 = (m0, l0, a0)
+
+    static_tiles = _static_tile_schedule(block_q, block_k, block, window,
+                                         global_blocks, causal)
+    if static_tiles is not None:
+        # static schedule: the (python-unrolled) global tiles, then the
+        # diagonal — exactly the tiles the layout allows, 2 MXU tiles per
+        # grid step at the default layout instead of a 10-tile scan
+        carry = carry0
+        for gt in static_tiles:
+            # causal: a global tile in the future of this q-tile is fully
+            # masked; one cond per STATIC tile (len 1 by default)
+            carry = lax.cond(jnp.int32(gt) <= iq,
+                             functools.partial(update, jnp.int32(gt)),
+                             lambda c: c, carry)
+        dup = jnp.zeros((), bool)
+        for gt in static_tiles:           # diagonal may BE a global tile
+            dup = dup | (iq == gt)
+        m, l, acc = lax.cond(dup, lambda c: c,
+                             functools.partial(update, iq), carry)
+    else:
+        num_k = pl.cdiv(seq_len, block_k)
+        if causal:
+            num_k = jnp.minimum(num_k,
+                                pl.cdiv((iq + 1) * block_q, block_k))
+
+        w_lo_q = (iq * block_q) // window
+        w_hi_q = (iq * block_q + block_q - 1) // window
+
+        def tile_any(ik):
+            w_lo_k = (ik * block_k) // window
+            w_hi_k = (ik * block_k + block_k - 1) // window
+            overlap = (w_lo_k <= w_hi_q) & (w_lo_q <= w_hi_k)
+            for g in global_blocks:
+                tok = g * block
+                overlap = overlap | ((tok >= ik * block_k)
+                                     & (tok < (ik + 1) * block_k))
+            return overlap
+
+        def body(ik, carry):
+            return lax.cond(tile_any(ik), functools.partial(update, ik),
+                            lambda c: c, carry)
+
+        m, l, acc = lax.fori_loop(0, num_k, body, carry0)
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)

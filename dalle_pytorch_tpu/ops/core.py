@@ -18,6 +18,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 Array = jax.Array
 
@@ -80,11 +81,18 @@ def layernorm_init(dim: int, dtype=jnp.float32) -> dict:
 
 def layernorm(params: dict, x: Array, *, eps: float = 1e-5) -> Array:
     # Normalise in f32 for numerical stability, cast back to input dtype.
-    xf = x.astype(jnp.float32)
+    # The two full-size f32 intermediates are tagged with checkpoint_name
+    # so remat='save_ln' can drop EXACTLY these from the saved residuals
+    # (docs/ANALYSIS_NORTH.md: they dominate the un-rematerialized stack's
+    # activation bytes — 2 x 4 bytes/elt vs the bf16 compute stream) while
+    # keeping every matmul output saved. checkpoint_name is an identity
+    # outside jax.checkpoint.
+    xf = checkpoint_name(x.astype(jnp.float32), "ln_f32_in")
     mean = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
     y = (xf - mean) * lax.rsqrt(var + eps)
     y = y * params["g"].astype(jnp.float32) + params["b"].astype(jnp.float32)
+    y = checkpoint_name(y, "ln_f32_out")
     return y.astype(x.dtype)
 
 

@@ -68,7 +68,7 @@ class TransformerConfig:
     sparse_impl: str = "ref"    # 'ref' | 'windowed' | 'pallas'
     # reference uses dim**-0.5 (transformer.py:57); 'head' gives dim_head**-0.5
     scale_mode: str = "dim"
-    remat: str = "none"          # 'none' | 'dots' | 'full'
+    remat: str = "none"          # 'none' | 'save_ln' | 'dots' | 'full'
     # Mixture-of-Experts FF (beyond reference — SURVEY.md §2b lists EP/MoE
     # absent): 0 = plain GEGLU; >0 replaces every FF with a top-k MoE of
     # that many experts (ops.moe), expert axis shardable over 'ep'
@@ -138,15 +138,25 @@ def _maybe_remat(body, mode: str):
     whole body in the backward (max memory savings, ~1/3 more FLOPs);
     'dots' keeps matmul outputs saved and recomputes only the vector work
     (layernorm/gelu/elementwise — near-zero extra MXU FLOPs, ~2/3 of the
-    saved-activation bytes reclaimed)."""
+    saved-activation bytes reclaimed); 'save_ln' is the surgical variant:
+    save EVERYTHING except the two tagged f32 layernorm intermediates per
+    block (core.layernorm's checkpoint_names) — the cheapest possible
+    recompute (a layernorm each) for the bytes that actually drive OOM
+    (docs/ANALYSIS_NORTH.md: 8 f32 saves/layer dominate the flash stack's
+    activation footprint)."""
     if mode == "full":
         return jax.checkpoint(body)
     if mode == "dots":
         return jax.checkpoint(
             body, policy=jax.checkpoint_policies.dots_saveable)
+    if mode == "save_ln":
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_anything_except_these_names(
+                "ln_f32_in", "ln_f32_out"))
     if mode != "none":
-        raise ValueError(f"remat must be 'none', 'dots' or 'full', "
-                         f"got {mode!r}")
+        raise ValueError(f"remat must be 'none', 'dots', 'full' or "
+                         f"'save_ln', got {mode!r}")
     return body
 
 

@@ -25,8 +25,12 @@ sp-degree-invariant: both dropout sites (post-attention projection, FF
 hidden) are position-local, so their masks are drawn from PER-POSITION
 keys (``core.positional_dropout`` with offset = shard start) — the same
 rng gives bit-identical masks on every sp degree, and the flagship
-dropout-0.1 config trains under ``--sp``. Restrictions (asserted): dense
-attention only, no reversible engine.
+dropout-0.1 config trains under ``--sp``. ``cfg.remat`` composes (the
+checkpointed body re-runs its ring/all-to-all collectives in the
+backward), as do extra GSPMD mesh axes: only sp/batch are manual
+(``shard_map(axis_names=...)``), so tp/fsdp param shardings ride through
+— dp x tp x sp with remat is the long-context training recipe.
+Restrictions (asserted): dense attention only, no reversible engine.
 """
 
 from __future__ import annotations
@@ -44,10 +48,10 @@ from dalle_pytorch_tpu.ops import transformer as T
 from dalle_pytorch_tpu.parallel.ring import (ring_attention_local,
                                              ulysses_attention_local)
 
-try:
-    from jax import shard_map            # jax >= 0.8
-except ImportError:                      # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# jax >= 0.8 required: this module leans on shard_map(axis_names=...)
+# (partial-manual lowering) which the old experimental shard_map lacks —
+# a silent fallback would only defer the failure to every call site
+from jax import shard_map
 
 
 def _check_cfg(cfg: T.TransformerConfig) -> None:
@@ -122,17 +126,31 @@ def sp_transformer_apply(params, x, *, cfg: T.TransformerConfig, mesh: Mesh,
                     k, t, cfg.ff_dropout, train, offset=offset))
             return h, None
 
-        out, _ = lax.scan(body, x, (params, keys))
+        # remat composes with sequence sharding: jax.checkpoint inside the
+        # shard_map body re-runs the layer (including the ring ppermutes /
+        # the ulysses all-to-alls) in the backward — activation thrift and
+        # sequence sharding together are exactly the long-context recipe
+        out, _ = lax.scan(T._maybe_remat(body, cfg.remat), x, (params, keys))
         return out
 
     x_spec = P(batch_axis, sp_axis, None)
     m_spec = P(batch_axis, sp_axis)
+    # Only the token/batch axes are MANUAL (ring ppermutes / all-to-alls
+    # written by hand); every other mesh axis stays auto, so e.g. a
+    # dp x tp x sp mesh runs Megatron tp INSIDE this shard_map with
+    # GSPMD-placed collectives — the 3-axis long-context recipe — without
+    # this file knowing tp exists. Params use in_specs P(): replicated
+    # over the manual axes, while any auto-axis sharding (tp/fsdp) rides
+    # through untouched.
+    manual = frozenset(a for a in (sp_axis, batch_axis) if a is not None)
     if mask is None:
         return shard_map(lambda p, k, x: stack(p, k, x, None), mesh=mesh,
                          in_specs=(P(), P(), x_spec),
-                         out_specs=x_spec)(params, keys, x)
+                         out_specs=x_spec,
+                         axis_names=manual)(params, keys, x)
     return shard_map(stack, mesh=mesh, in_specs=(P(), P(), x_spec, m_spec),
-                     out_specs=x_spec)(params, keys, x, mask)
+                     out_specs=x_spec, axis_names=manual)(params, keys, x,
+                                                          mask)
 
 
 def sp_dalle_loss_fn(cfg, mesh: Mesh, *, sp_axis: str = "sp",

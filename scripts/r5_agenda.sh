@@ -55,6 +55,13 @@ python scripts/tune_north.py --attns flash --batches 8 \
   --loss_chunks 256 --flash_blocks 256x256,128x256,256x128,640x128 \
   --claim_retries 3 \
   && echo "[$(stamp)] tile sweep OK" || echo "[$(stamp)] tile sweep FAILED"
+# the new surgical remat lever (drop ONLY the f32 layernorm saves):
+# r4's sweep showed batch>=16 loses to 8 because of activation traffic —
+# save_ln reclaims the dominant bytes at the cost of a layernorm
+# recompute, so the 16/32 points get one more honest shot
+python scripts/tune_north.py --attns flash --batches 16,32 \
+  --loss_chunks 256 --remats save_ln --claim_retries 3 \
+  && echo "[$(stamp)] save_ln leg OK" || echo "[$(stamp)] save_ln leg FAILED"
 
 echo "[$(stamp)] == 5/5 conditional re-bench =="
 rebench_if_improved "$best_before" r5b

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Round-5 CFG demo leg (VERDICT r4 item 6): waits for the banking agenda
+# (scripts/r5_agenda.sh) to finish so the two never compete for the chip,
+# then trains the caption-dropout DALLE and samples the guidance sweep
+# via scripts/tpu_demo.sh (resume-aware: short windows make incremental
+# progress).
+#   nohup bash scripts/r5_demo.sh > /tmp/r5_demo.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+. scripts/window_lib.sh
+
+while pgrep -f 'scripts/r5_agenda\.sh' > /dev/null; do
+  echo "[$(stamp)] banking agenda still running; waiting 120s"
+  sleep 120
+done
+
+wait_healthy_tunnel
+echo "[$(stamp)] == CFG demo (tpu_demo.sh) =="
+bash scripts/tpu_demo.sh && echo "[$(stamp)] demo OK" \
+  || echo "[$(stamp)] demo FAILED"
+echo "[$(stamp)] r5 demo leg complete — inspect docs/demo/guidance_*/"

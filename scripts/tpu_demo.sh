@@ -15,8 +15,11 @@
 # trainings, per-epoch recon grids, generated sample grids.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-OUT=docs/demo
-DATA=data/demo
+# OUT/DATA/MODELS overridable so a CPU rehearsal can run in a scratch dir
+# without touching the committed docs/demo artifacts
+OUT=${OUT:-docs/demo}
+DATA=${DATA:-data/demo}
+MODELS=${MODELS:-models}
 mkdir -p "$OUT"
 
 # Scale knobs (defaults = the real chip run; the CPU rehearsal in CI-ish
@@ -55,21 +58,22 @@ fi
 # Same guard as the dataset stamp, for models/: resumed runs take their
 # config from the checkpoint manifest, so a leftover rehearsal checkpoint
 # (different arch knobs) must not hijack a real run via --loadVAE.
-mstamp="models/.demo_stamp_${IMG_SIZE}_${DIM}_${DEPTH}_${TOKENS}_${CDIM}_${HID}_${LAYERS}"
-mkdir -p models
+mstamp="$MODELS/.demo_stamp_${IMG_SIZE}_${DIM}_${DEPTH}_${TOKENS}_${CDIM}_${HID}_${LAYERS}"
+mkdir -p "$MODELS"
 if [ ! -f "$mstamp" ]; then
-  rm -rf models/demovae-* models/demodalle_dalle-* models/.demo_stamp_*
-  rm -f "$OUT/vae_loss.jsonl" "$OUT/dalle_loss.jsonl"  # curves restart too
+  rm -rf "$MODELS"/demovae-* "$MODELS"/demodalle_dalle-* "$MODELS"/democfg_dalle-* "$MODELS"/.demo_stamp_*
+  rm -f "$OUT/vae_loss.jsonl" "$OUT/dalle_loss.jsonl" \
+        "$OUT/cfg_loss.jsonl"                          # curves restart too
   touch "$mstamp"
 fi
 
 # `latest_epoch NAME` prints the newest checkpoint's epoch for NAME under
-# models/, or -1.
+# $MODELS/, or -1.
 latest_epoch() {
-  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - "$1" <<'EOF'
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - "$1" "$MODELS" <<'EOF'
 import sys
 from dalle_pytorch_tpu import checkpoint as ckpt
-found = ckpt.latest("models", sys.argv[1])
+found = ckpt.latest(sys.argv[2], sys.argv[1])
 print(-1 if found is None else found[1])
 EOF
 }
@@ -89,7 +93,7 @@ else
     --dataPath "$DATA/images" --imageSize "$IMG_SIZE" --batchSize 16 \
     --n_epochs "$remaining" --name demovae --num_tokens "$TOKENS" \
     --codebook_dim "$CDIM" --hidden_dim "$HID" --num_layers "$LAYERS" \
-    --lr 3e-4 --tempsched --models_dir models --results_dir "$OUT" \
+    --lr 3e-4 --tempsched --models_dir "$MODELS" --results_dir "$OUT" \
     --metrics "$OUT/vae_loss.jsonl" --log_interval 10 $resume_flags
 fi
 
@@ -110,7 +114,7 @@ else
     --vaename demovae --vae_epoch "$((VAE_EPOCHS - 1))" --name demodalle \
     --n_epochs "$remaining" --dim "$DIM" --depth "$DEPTH" --heads 8 \
     --dim_head "$((DIM / 8))" --num_text_tokens 64 --text_seq_len 32 \
-    --attn_dropout 0.1 --ff_dropout 0.1 --lr 3e-4 --models_dir models \
+    --attn_dropout 0.1 --ff_dropout 0.1 --lr 3e-4 --models_dir "$MODELS" \
     --results_dir "$OUT" --metrics "$OUT/dalle_loss.jsonl" \
     --log_interval 10 --sample_every 8 $resume_flags
 fi
@@ -121,7 +125,45 @@ for prompt in "a photo of a purple flower" \
               "a portrait of a woman in uniform"; do
   python -m dalle_pytorch_tpu.cli.gen_dalle "$prompt" --name demodalle \
     --dalle_epoch "$((DALLE_EPOCHS - 1))" --num_images 8 \
-    --models_dir models --results_dir "$OUT"
+    --models_dir "$MODELS" --results_dir "$OUT"
+done
+
+# -- classifier-free-guidance proof (VERDICT r4 item 6) ---------------------
+# A second DALLE trained WITH caption dropout (the unconditional stream CFG
+# needs), then the same prompt sampled at guidance 1/2/4 — the committed
+# grids are the end-to-end evidence that guidance actually sharpens prompt
+# adherence, not just that the math is parity-tested at s=1.
+CFG_EPOCHS=${CFG_EPOCHS:-$DALLE_EPOCHS}
+cfg_done=$(latest_epoch democfg_dalle)
+if [ "$cfg_done" -ge "$((CFG_EPOCHS - 1))" ]; then
+  echo "== train_dalle (cfg): complete at epoch $cfg_done, skipping =="
+else
+  resume_flags=""
+  remaining="$CFG_EPOCHS"
+  if [ "$cfg_done" -ge 0 ]; then
+    resume_flags="--load_dalle democfg"
+    remaining="$((CFG_EPOCHS - cfg_done - 1))"
+  fi
+  echo "== train_dalle with --caption_drop 0.1 ($remaining of $CFG_EPOCHS epochs) =="
+  python -m dalle_pytorch_tpu.cli.train_dalle \
+    --dataPath "$DATA/images" --imageSize "$IMG_SIZE" --batchSize 16 \
+    --captions_only "$DATA/only.txt" --captions "$DATA/captions.txt" \
+    --vaename demovae --vae_epoch "$((VAE_EPOCHS - 1))" --name democfg \
+    --n_epochs "$remaining" --dim "$DIM" --depth "$DEPTH" --heads 8 \
+    --dim_head "$((DIM / 8))" --num_text_tokens 64 --text_seq_len 32 \
+    --attn_dropout 0.1 --ff_dropout 0.1 --caption_drop 0.1 --lr 3e-4 \
+    --models_dir "$MODELS" --results_dir "$OUT" \
+    --metrics "$OUT/cfg_loss.jsonl" --log_interval 10 $resume_flags
+fi
+
+echo "== gen_dalle guidance sweep =="
+for g in 1 2 4; do
+  for prompt in "a photo of a purple flower" \
+                "a portrait of a woman in uniform"; do
+    python -m dalle_pytorch_tpu.cli.gen_dalle "$prompt" --name democfg \
+      --dalle_epoch "$((CFG_EPOCHS - 1))" --num_images 8 --guidance "$g" \
+      --models_dir "$MODELS" --results_dir "$OUT/guidance_$g"
+  done
 done
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 python scripts/plot_demo.py --dir "$OUT" || true

@@ -530,6 +530,31 @@ class TestTrainDALLESequenceParallel:
         path, epoch = ckpt.latest(str(workdir / "models"), "spdrop_dalle")
         assert epoch == 0
 
+    def test_sp_trains_with_remat_full(self, workdir):
+        """--sp 4 --remat full (VERDICT r4 item 7): sequence sharding and
+        activation thrift compose in one program — the long-context
+        training recipe trains and checkpoints through the CLI."""
+        require_ckpt(workdir, "vae", 2)
+        from dalle_pytorch_tpu.cli.train_dalle import main
+        main([
+            "--dataPath", str(workdir / "imagedata"),
+            "--imageSize", str(IMG), "--batchSize", "4",
+            "--captions_only", str(workdir / "only.txt"),
+            "--captions", str(workdir / "pairs.txt"),
+            "--vaename", "vae", "--vae_epoch", "2",
+            "--name", "spremat", "--n_epochs", "1",
+            "--dim", "16", "--depth", "2", "--heads", "4",
+            "--dim_head", "4", "--num_text_tokens", "50",
+            "--text_seq_len", "8", "--attn_dropout", "0",
+            "--ff_dropout", "0", "--lr", "1e-3", "--sp", "4",
+            "--remat", "full",
+            "--models_dir", str(workdir / "models"),
+            "--results_dir", str(workdir / "results"),
+            "--log_interval", "1", "--sample_every", "100",
+        ])
+        path, epoch = ckpt.latest(str(workdir / "models"), "spremat_dalle")
+        assert epoch == 0
+
 
 class TestTrainDALLEMoE:
     def test_moe_train_runs_and_checkpoints(self, workdir):

@@ -435,6 +435,61 @@ class TestSequenceParallelStack:
         np.testing.assert_allclose(np.asarray(y_sp), np.asarray(y_ref),
                                    atol=2e-5)
 
+    @pytest.mark.parametrize("mode", ["save_ln", "dots", "full"])
+    def test_remat_composes_with_sp(self, mode):
+        """Long-context training needs sequence sharding AND activation
+        thrift in one program (VERDICT r4 item 7): under every remat mode
+        the sp stack's loss AND grads match the un-rematerialized
+        single-device path (f32, so the recompute is deterministic)."""
+        import dataclasses
+        from dalle_pytorch_tpu.ops.transformer import transformer_apply
+        from dalle_pytorch_tpu.parallel import (make_mesh,
+                                                sp_transformer_apply)
+        cfg, params, x = self._stack()
+        cfg_r = dataclasses.replace(cfg, remat=mode)
+        mesh = make_mesh({"sp": 4}, jax.devices()[:4])
+
+        def loss_sp(p):
+            return jnp.sum(sp_transformer_apply(p, x, cfg=cfg_r,
+                                                mesh=mesh) ** 2)
+
+        def loss_ref(p):
+            return jnp.sum(transformer_apply(p, x, cfg=cfg) ** 2)
+
+        l1, g1 = jax.value_and_grad(loss_ref)(params)
+        # jit is required: a named-policy jax.checkpoint inside shard_map
+        # cannot evaluate eagerly (closed_call), and real training always
+        # runs the step under jit anyway
+        l2, g2 = jax.jit(jax.value_and_grad(loss_sp))(params)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5), g1, g2)
+
+    def test_three_axis_dp_tp_sp(self):
+        """dp x tp x sp in ONE program (VERDICT r4 item 7): the shard_map
+        is manual over dp/sp only, so Megatron-tp param shardings ride
+        through as GSPMD auto axes — output matches the single-device
+        dense stack."""
+        from jax.sharding import NamedSharding
+
+        from dalle_pytorch_tpu.ops.transformer import transformer_apply
+        from dalle_pytorch_tpu.parallel import (make_mesh,
+                                                sp_transformer_apply)
+        from dalle_pytorch_tpu.parallel.train import dalle_param_specs
+        cfg, params, x = self._stack()
+        mesh = make_mesh({"dp": 2, "tp": 2, "sp": 2})
+        specs = dalle_param_specs(params, tp="tp")
+        params = jax.tree.map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            params, specs)
+        x = jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
+        y_sp = jax.jit(lambda p, x: sp_transformer_apply(
+            p, x, cfg=cfg, mesh=mesh, batch_axis="dp"))(params, x)
+        y_ref = transformer_apply(jax.device_get(params),
+                                  jax.device_get(x), cfg=cfg)
+        np.testing.assert_allclose(np.asarray(y_sp), np.asarray(y_ref),
+                                   atol=2e-5)
+
     def test_rejects_sparse_reversible(self):
         import dataclasses
         from dalle_pytorch_tpu.parallel import (make_mesh,

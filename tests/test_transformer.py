@@ -107,7 +107,7 @@ def test_windowed_impl_matches_ref_in_stack(key):
     np.testing.assert_allclose(np.array(y_w), np.array(y_r), atol=1e-5)
 
 
-@pytest.mark.parametrize("mode", ["dots", "full"])
+@pytest.mark.parametrize("mode", ["save_ln", "dots", "full"])
 def test_remat_matches_plain(key, mode):
     """'full' recomputes the whole layer body; 'dots' keeps matmul outputs
     and recomputes only vector work (measured ~65% residual-byte cut on
