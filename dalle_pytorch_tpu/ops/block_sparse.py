@@ -247,10 +247,105 @@ def _bs_fwd_rule(q, k, v, mask, scale, causal, block, num_local_blocks,
     return out, (q, k, v, mask, out, stats)
 
 
+def _bs_bwd_static(q, k, v, mask, dout, out, stats, *, scale, block, window,
+                   global_blocks, tile):
+    """Backward specialized to the static tile schedule (global tile 0 +
+    diagonal): instead of scanning every key tile at dense cost (the
+    shared blockwise backward — the r4-measured reason the Pallas train
+    path lost to its oracle), compute exactly the two structural pieces:
+
+      * DIAGONAL — per-tile (tile x tile) attention blocks, one batched
+        einsum over all tiles at once (no scan);
+      * GLOBAL STRIP — rows of tiles 1.. against key tile 0 only.
+
+    Work drops from num_tiles to 2 tiles per query row — the same
+    schedule the forward kernel runs. Semantics mirror
+    blockwise_attention_bwd exactly (pad keys FILLed with ds zeroed,
+    structural -inf, f32 accumulation with input-dtype MXU operands)."""
+    m_stat, l_stat = stats
+    b, h, n, d = q.shape
+    T = n // tile
+    cdt = q.dtype
+    inv_l = (1.0 / l_stat).astype(jnp.float32)
+    D = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1)                                        # (b, h, n)
+    ar = jnp.arange(n)
+
+    def pieces(qi, ki, vi, doi, mi, li, Di, row_ids, col_ids, key_mask):
+        """dq/dk/dv for one structural piece. Leading dims broadcast:
+        qi (..., R, d), ki/vi (..., C, d), mi/li/Di (..., R), row_ids
+        (..., R), col_ids (..., C), key_mask (..., C) or None."""
+        s = jnp.einsum("...id,...jd->...ij", qi, ki,
+                       preferred_element_type=jnp.float32) * scale
+        live = None
+        if key_mask is not None:
+            live = key_mask[..., None, :]
+            s = jnp.where(live, s, FILL)
+        struct = _structural(row_ids[..., :, None], col_ids[..., None, :],
+                             block=block, window=window,
+                             global_blocks=global_blocks, causal=True)
+        s = jnp.where(struct, s, -jnp.inf)
+        p = jnp.exp(s - mi[..., None]) * li[..., None]
+        dv = jnp.einsum("...ij,...id->...jd", p.astype(cdt),
+                        doi.astype(cdt), preferred_element_type=jnp.float32)
+        dp = jnp.einsum("...id,...jd->...ij", doi.astype(cdt),
+                        vi.astype(cdt), preferred_element_type=jnp.float32)
+        ds = p * (dp - Di[..., None]) * scale
+        if live is not None:
+            ds = jnp.where(live, ds, 0.0)
+        ds_c = ds.astype(cdt)
+        dk = jnp.einsum("...ij,...id->...jd", ds_c, qi.astype(cdt),
+                        preferred_element_type=jnp.float32)
+        dq = jnp.einsum("...ij,...jd->...id", ds_c, ki.astype(cdt),
+                        preferred_element_type=jnp.float32)
+        return dq, dk, dv
+
+    def tiled(x):
+        if x.ndim == 4:                       # (b, h, n, d) operands
+            return x.reshape(b, h, T, tile, x.shape[-1])
+        return x.reshape(b, h, T, tile)       # (b, h, n) stats
+
+    # diagonal: every (tile x tile) block at once, batched over T
+    km_d = None
+    if mask is not None:
+        km_d = mask.reshape(b, 1, T, tile)
+    ids = ar.reshape(T, tile)
+    dq_d, dk_d, dv_d = pieces(
+        tiled(q), tiled(k), tiled(v), tiled(dout), tiled(m_stat),
+        tiled(inv_l), tiled(D), ids, ids, km_d)
+
+    # global strip: rows of tiles 1.. against key tile 0
+    km_g = None
+    if mask is not None:
+        km_g = mask[:, None, :tile]
+    dq_g, dk_g, dv_g = pieces(
+        q[:, :, tile:], k[:, :, :tile], v[:, :, :tile], dout[:, :, tile:],
+        m_stat[:, :, tile:], inv_l[:, :, tile:], D[:, :, tile:],
+        ar[tile:], ar[:tile], km_g)
+
+    dq = dq_d.reshape(b, h, n, d).at[:, :, tile:].add(dq_g)
+    dk = dk_d.reshape(b, h, n, d).at[:, :, :tile].add(dk_g)
+    dv = dv_d.reshape(b, h, n, d).at[:, :, :tile].add(dv_g)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _bs_bwd_rule(scale, causal, block, num_local_blocks, global_blocks,
                  blocks_qk, interpret, res, dout):
     q, k, v, mask, out, stats = res
     window = num_local_blocks * block
+    n = q.shape[2]
+    bq, bk = blocks_qk
+
+    # the same layout factorization the forward kernel exploits: when the
+    # schedule is static with global tile 0, the backward runs as two
+    # batched einsum pieces instead of a dense-cost scan over key tiles
+    schedule = _static_tile_schedule(bq, bk, block, window, global_blocks,
+                                     causal)
+    if schedule == [0] and n % bk == 0 and n > bk:
+        dq, dk, dv = _bs_bwd_static(
+            q, k, v, mask, dout, out, stats, scale=scale, block=block,
+            window=window, global_blocks=global_blocks, tile=bk)
+        return dq, dk, dv, None
 
     def structural(rows, cols):
         return _structural(rows[:, None], cols[None, :], block=block,
@@ -259,7 +354,7 @@ def _bs_bwd_rule(scale, causal, block, num_local_blocks, global_blocks,
 
     dq, dk, dv = blockwise_attention_bwd(
         q, k, v, mask, dout, out, stats, scale=scale,
-        block_k=min(blocks_qk[1], q.shape[2]), structural_mask_fn=structural,
+        block_k=min(bk, n), structural_mask_fn=structural,
         mask_queries=False)
     return dq, dk, dv, None
 
