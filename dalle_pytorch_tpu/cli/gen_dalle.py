@@ -90,10 +90,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint has none. A CLIP rerank checkpoint "
                         "without EMA falls back to raw weights with a "
                         "note")
-    p.add_argument("--quantize", choices=("none", "int8"), default="none",
+    p.add_argument("--quantize", choices=("none", "int8", "int8_kv"),
+                   default="none",
                    help="int8: quantize the transformer linears + vocab "
                         "head after restore (halves per-token weight HBM "
-                        "traffic; ops/quant.py)")
+                        "traffic; ops/quant.py); int8_kv: additionally "
+                        "store the KV cache int8 with per-row scales "
+                        "(halves the cache read share too — the dominant "
+                        "decode bytes at num_images > 1)")
     p.add_argument("--seed", type=int, default=0)
     return p
 
@@ -132,7 +136,7 @@ def main(argv=None):
     # traced positions, which needs device arrays
     params = jax.device_put(params)
     vae_params = jax.device_put(vae_params)
-    if args.quantize == "int8":
+    if args.quantize in ("int8", "int8_kv"):
         params = D.quantize_for_decode(params)
 
     vocab = load_vocab(args)
@@ -173,7 +177,9 @@ def main(argv=None):
         return D.generate_images(p, vp, t, cfg=cfg, rng=rng,
                                  filter_thres=args.filter_thres,
                                  top_p=args.top_p, guidance=args.guidance,
-                                 temperature=args.temperature, **kw)
+                                 temperature=args.temperature,
+                                 quantize_cache=args.quantize == "int8_kv",
+                                 **kw)
 
     out = gen(params, vae_params, text, jax.random.PRNGKey(args.seed),
               clip_kwargs.get("clip_params"))

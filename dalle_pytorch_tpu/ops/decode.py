@@ -43,9 +43,53 @@ from dalle_pytorch_tpu.ops import core, sparse
 Array = jax.Array
 
 
-def init_cache(cfg, batch: int, total_len: int, dtype=jnp.float32) -> dict:
+def init_cache(cfg, batch: int, total_len: int, dtype=jnp.float32,
+               quantized: bool = False) -> dict:
+    """K/V buffers. ``quantized=True`` stores int8 rows with per-row f32
+    scales (beyond reference — the decode roofline in bench.py shows
+    cache reads are ~22% of batch-1 decode bytes and the dominant term
+    at batch > 1; int8 halves them). Rows are written once and read
+    every later step, so the quantization cost is paid once per row."""
     shape = (cfg.depth, batch, cfg.heads, total_len, cfg.dim_head)
+    if quantized:
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+                "v_scale": jnp.zeros(shape[:-1], jnp.float32)}
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _quantize_rows(x: Array):
+    """(..., dh) -> (int8 rows, (...,) f32 scales), symmetric per row."""
+    scale = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1),
+                        1e-12) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _store_rows(cache: dict, ks: Array, vs: Array, pos) -> dict:
+    """Write K/V rows (depth, b, heads, rows, dh) into the cache starting
+    at ``pos`` — the ONE definition of the cache write for prefill and
+    decode_step, quantizing iff the cache is the int8 variant (so the
+    two writers can never diverge on layout)."""
+    if "k_scale" in cache:
+        kq, ksc = _quantize_rows(ks)
+        vq, vsc = _quantize_rows(vs)
+        return {
+            "k": lax.dynamic_update_slice(cache["k"], kq,
+                                          (0, 0, 0, pos, 0)),
+            "v": lax.dynamic_update_slice(cache["v"], vq,
+                                          (0, 0, 0, pos, 0)),
+            "k_scale": lax.dynamic_update_slice(cache["k_scale"], ksc,
+                                                (0, 0, 0, pos)),
+            "v_scale": lax.dynamic_update_slice(cache["v_scale"], vsc,
+                                                (0, 0, 0, pos)),
+        }
+    return {
+        "k": lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, pos, 0)),
+        "v": lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, pos, 0)),
+    }
 
 
 def _full_key_mask(prompt_mask: Optional[Array], batch: int, prompt_len: int,
@@ -85,10 +129,12 @@ def _attn_with_kv(lp: dict, h: Array, allowed: Array, cfg
 
 
 def prefill(params: dict, x: Array, *, cfg, total_len: int,
-            prompt_mask: Optional[Array] = None) -> Tuple[Array, dict]:
+            prompt_mask: Optional[Array] = None,
+            quantize_cache: bool = False) -> Tuple[Array, dict]:
     """Run the prompt embeddings x (b, t0, dim) through the stack.
 
     Returns (h_out (b, t0, dim), cache with rows [0, t0) filled).
+    ``quantize_cache`` stores the cache int8 (see init_cache).
     """
     from dalle_pytorch_tpu.ops import transformer as T
     b, t0, _ = x.shape
@@ -127,12 +173,9 @@ def prefill(params: dict, x: Array, *, cfg, total_len: int,
     carry, (ks, vs) = lax.scan(body, carry0, (params, sparse_flags))
     h_out = (carry[0] + carry[1]) * 0.5 if cfg.reversible else carry
 
-    cache = init_cache(cfg, b, total_len, ks.dtype)
-    cache = {
-        "k": lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0)),
-        "v": lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0)),
-    }
-    return h_out, cache
+    cache = init_cache(cfg, b, total_len, ks.dtype,
+                       quantized=quantize_cache)
+    return h_out, _store_rows(cache, ks, vs, 0)
 
 
 def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,
@@ -159,43 +202,60 @@ def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,
         sparse_allowed = dense_allowed
 
     h_in = x_tok[:, None, :]                                  # (b, 1, dim)
+    quantized = "k_scale" in cache
 
-    def attn_cached(lp, h, ck, cv, is_sparse):
+    def attn_cached(lp, h, ck, cv, is_sparse, ksc=None, vsc=None):
         p = lp["attn"]
         hn = core.layernorm(p["ln"], h)
         q, k, v = attn_ops.qkv_project(p, hn, cfg.heads)      # (b, h, 1, dh)
         allowed = jnp.where(is_sparse, sparse_allowed, dense_allowed) \
             if any_sparse else dense_allowed
-        scores = jnp.einsum("bhqd,bhjd->bhqj", q, ck) * cfg.scale
+        # int8 cache: XLA reads int8 rows from HBM, upcasts in registers,
+        # and the per-row scales apply OUTSIDE the contractions (along j),
+        # so no dequantized copy materializes — same trick as ops/quant
+        ckc = ck.astype(q.dtype) if quantized else ck
+        scores = jnp.einsum("bhqd,bhjd->bhqj", q, ckc) * cfg.scale
+        if quantized:
+            # scales applied in the SCORE dtype: an f32 multiply would
+            # promote the whole decode carry to f32 under bf16 params
+            # (scan carry dtype mismatch) and double the vector bytes
+            scores = scores * ksc[:, :, None, :].astype(scores.dtype)
         scores = jnp.where(allowed[:, None, None, :], scores,
                            core.neg_inf(scores.dtype))
         self_score = jnp.einsum("bhqd,bhqd->bhq", q, k)[..., None] * cfg.scale
         w = jax.nn.softmax(jnp.concatenate([scores, self_score], -1), axis=-1)
-        out = (jnp.einsum("bhqj,bhjd->bhqd", w[..., :-1], cv)
-               + w[..., -1:] * v)
+        wj = w[..., :-1]
+        if quantized:
+            wj = wj * vsc[:, :, None, :].astype(wj.dtype)
+            cvc = cv.astype(q.dtype)
+        else:
+            cvc = cv
+        out = jnp.einsum("bhqj,bhjd->bhqd", wj, cvc) + w[..., -1:] * v
         return attn_ops.output_tail(p, out), k, v
 
     def body(carry, xs):
-        lp, ck, cv, is_sparse = xs
+        if quantized:
+            lp, ck, cv, ksc, vsc, is_sparse = xs
+        else:
+            lp, ck, cv, is_sparse = xs
+            ksc = vsc = None
         if cfg.reversible:
             x1, x2 = carry
-            a, k, v = attn_cached(lp, x2, ck, cv, is_sparse)
+            a, k, v = attn_cached(lp, x2, ck, cv, is_sparse, ksc, vsc)
             y1 = x1 + a
             y2 = x2 + T.ff_or_moe(lp, y1, cfg, None, False)[0]
             return (y1, y2), (k, v)
         h = carry
-        a, k, v = attn_cached(lp, h, ck, cv, is_sparse)
+        a, k, v = attn_cached(lp, h, ck, cv, is_sparse, ksc, vsc)
         h = h + a
         h = h + T.ff_or_moe(lp, h, cfg, None, False)[0]
         return h, (k, v)
 
     carry0 = (h_in, h_in) if cfg.reversible else h_in
-    carry, (ks, vs) = lax.scan(body, carry0,
-                               (params, cache["k"], cache["v"], sparse_flags))
+    xs = (params, cache["k"], cache["v"], cache["k_scale"],
+          cache["v_scale"], sparse_flags) if quantized else \
+        (params, cache["k"], cache["v"], sparse_flags)
+    carry, (ks, vs) = lax.scan(body, carry0, xs)
     h_out = (carry[0] + carry[1]) * 0.5 if cfg.reversible else carry
 
-    cache = {
-        "k": lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, pos, 0)),
-        "v": lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, pos, 0)),
-    }
-    return h_out[:, 0, :], cache
+    return h_out[:, 0, :], _store_rows(cache, ks, vs, pos)

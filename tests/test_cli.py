@@ -235,9 +235,11 @@ class TestTrainDALLE:
                 "--results_dir", str(workdir / "results"),
             ])
 
-    def test_gen_dalle_quantized(self, workdir):
+    @pytest.mark.parametrize("mode", ["int8", "int8_kv"])
+    def test_gen_dalle_quantized(self, workdir, mode):
         """--quantize int8 runs the same sampler on int8 linears
-        (ops/quant.py) and still writes a grid."""
+        (ops/quant.py); int8_kv additionally stores the KV cache int8
+        (ops/decode.py). Both still write a grid."""
         require_ckpt(workdir, "toy_dalle", 0)
         from dalle_pytorch_tpu.cli.gen_dalle import main
         before = set(os.listdir(workdir / "results"))
@@ -246,7 +248,7 @@ class TestTrainDALLE:
             "--name", "toy", "--dalle_epoch", "0",
             "--models_dir", str(workdir / "models"),
             "--results_dir", str(workdir / "results"),
-            "--quantize", "int8",
+            "--quantize", mode,
         ])
         new = set(os.listdir(workdir / "results")) - before
         assert any(f.startswith("gendalletoy_epoch_0-") for f in new), \

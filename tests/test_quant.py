@@ -119,6 +119,56 @@ def test_quantized_generation_runs():
     assert bool(jnp.all(jnp.isfinite(imgs)))
 
 
+def test_quantized_kv_cache_decode_close():
+    """int8 KV cache (ops/decode.py): decode_step attention outputs track
+    the fp-cache path within quantization tolerance, the cache really
+    stores int8 rows, and a full generate runs finite end-to-end."""
+    from dalle_pytorch_tpu.ops import decode as decode_ops
+    key = jax.random.PRNGKey(0)
+    tcfg = CFG.transformer
+    params = D.dalle_init(key, CFG)["transformer"]
+    x = jax.random.normal(jax.random.fold_in(key, 1), (2, 8, CFG.dim))
+    total = CFG.seq_len
+
+    h_f, cache_f = decode_ops.prefill(params, x, cfg=tcfg, total_len=total)
+    h_q, cache_q = decode_ops.prefill(params, x, cfg=tcfg, total_len=total,
+                                      quantize_cache=True)
+    assert cache_q["k"].dtype == jnp.int8
+    assert cache_q["k_scale"].shape == cache_q["k"].shape[:-1]
+    # prefill output is cache-independent (queries attend pre-cache keys)
+    np.testing.assert_allclose(np.asarray(h_q), np.asarray(h_f), atol=1e-5)
+
+    key_mask = decode_ops._full_key_mask(None, 2, 8, total)
+    tok = jax.random.normal(jax.random.fold_in(key, 2), (2, CFG.dim))
+    out_f, cache_f = decode_ops.decode_step(params, tok, 8, cache_f,
+                                            cfg=tcfg, key_mask=key_mask)
+    out_q, cache_q = decode_ops.decode_step(params, tok, 8, cache_q,
+                                            cfg=tcfg, key_mask=key_mask)
+    assert cache_q["k"].dtype == jnp.int8       # written row stays int8
+    err = np.max(np.abs(np.asarray(out_q) - np.asarray(out_f)))
+    ref = np.max(np.abs(np.asarray(out_f)))
+    assert err / ref < 0.02, (err, ref)          # ~0.4% int8 step, headroom
+
+    # a second step reads the quantized row written by the first
+    tok2 = jax.random.normal(jax.random.fold_in(key, 3), (2, CFG.dim))
+    out_f2, _ = decode_ops.decode_step(params, tok2, 9, cache_f,
+                                       cfg=tcfg, key_mask=key_mask)
+    out_q2, _ = decode_ops.decode_step(params, tok2, 9, cache_q,
+                                       cfg=tcfg, key_mask=key_mask)
+    err2 = np.max(np.abs(np.asarray(out_q2) - np.asarray(out_f2)))
+    assert err2 / np.max(np.abs(np.asarray(out_f2))) < 0.02
+
+    # end-to-end: weights AND cache int8 in one jit program
+    vae_params = V.vae_init(jax.random.fold_in(key, 4), VCFG)
+    dparams = D.quantize_for_decode(D.dalle_init(key, CFG, vae_params))
+    text = jax.random.randint(jax.random.fold_in(key, 5), (1, 5), 3, 100)
+    imgs = D.generate_images(dparams, vae_params, text, cfg=CFG,
+                             rng=jax.random.fold_in(key, 6),
+                             quantize_cache=True)
+    assert imgs.shape == (1, 32, 32, 3)
+    assert bool(jnp.all(jnp.isfinite(imgs)))
+
+
 def test_quantized_moe_generation_runs():
     """Quantization composes with MoE decode: the router (a core.linear
     dict) quantizes, the expert einsum stacks stay raw — one program."""
