@@ -484,8 +484,10 @@ def decode_roofline_ms_per_token(cfg, quantize: str = "none",
     weight_bytes = (L * per_layer + head) * wbytes
     kv_bytes = batch * 2 * L * cfg.seq_len * dh * kvbytes
     if quantize == "int8_kv":
-        # each int8 row also reads its f32 per-row scale (K and V)
-        kv_bytes += batch * 2 * L * cfg.seq_len * 4
+        # each int8 row reads its f32 per-row scale too — one scale per
+        # (layer, batch, HEAD, position) for K and for V (decode.init_cache
+        # scale shape), so heads multiplies the count
+        kv_bytes += batch * 2 * L * cfg.seq_len * cfg.heads * 4
     return (weight_bytes + kv_bytes) / _hbm_bw() * 1e3
 
 

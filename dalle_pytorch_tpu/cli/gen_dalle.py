@@ -84,6 +84,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--clip_name", type=str, default="",
                    help="CLIP checkpoint name for reranking")
     p.add_argument("--clip_epoch", type=int, default=0)
+    p.add_argument("--scores_json", type=str, default="",
+                   help="append a JSONL record {caption, guidance, "
+                        "scores, mean_score} per run (requires "
+                        "--clip_name) — machine-readable prompt-"
+                        "adherence evidence for guidance sweeps")
     p.add_argument("--use_ema", action="store_true",
                    help="sample from the checkpoint's EMA weights "
                         "(train_dalle --ema_decay); errors if the DALLE "
@@ -112,7 +117,13 @@ def load_vocab(args) -> Vocabulary:
 
 
 def main(argv=None):
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.scores_json and not args.clip_name:
+        # fail at the flag, not in a downstream aggregator reading a file
+        # that was silently never written
+        parser.error("--scores_json needs --clip_name (the scores come "
+                     "from the CLIP rerank)")
 
     dalle_path = ckpt.ckpt_path(args.models_dir, f"{args.name}_dalle",
                                 args.dalle_epoch)
@@ -189,6 +200,19 @@ def main(argv=None):
         order = np.argsort(-np.asarray(scores))    # best first
         images = np.asarray(images)[order]
         say("clip scores (sorted):", np.asarray(scores)[order])
+        if args.scores_json:
+            # machine-readable adherence record (JSONL, appended): the
+            # demo's guidance sweep aggregates mean CLIP score per scale
+            # — quantitative CFG evidence, not just eyeballed grids
+            import json
+            rec = {"caption": args.caption, "guidance": args.guidance,
+                   "scores": [float(s) for s in np.asarray(scores)[order]],
+                   "mean_score": float(np.mean(np.asarray(scores)))}
+            os.makedirs(os.path.dirname(
+                os.path.abspath(args.scores_json)), exist_ok=True)
+            with open(args.scores_json, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            say(f"appended scores to {args.scores_json}")
     else:
         images = np.asarray(out)
 

@@ -273,17 +273,26 @@ class TestTrainDALLE:
                   cparams, step=0, config=ccfg, kind="clip")
 
         from dalle_pytorch_tpu.cli.gen_dalle import main
+        scores_path = workdir / "scores.jsonl"
         main([
             "a red square",
             "--name", "toy", "--dalle_epoch", "0",
             "--clip_name", "clip", "--clip_epoch", "0",
             "--models_dir", str(workdir / "models"),
             "--results_dir", str(workdir / "results"),
-            "--num_images", "2",
+            "--num_images", "2", "--guidance", "0",
+            "--scores_json", str(scores_path),
         ])
         outs = [f for f in os.listdir(workdir / "results")
                 if f.startswith("gendalletoy_epoch_0-")]
         assert outs
+        # --scores_json appended a machine-readable adherence record
+        import json
+        rec = json.loads(scores_path.read_text().splitlines()[-1])
+        assert rec["caption"] == "a red square"
+        assert rec["guidance"] == 0.0
+        assert len(rec["scores"]) == 2
+        assert rec["scores"] == sorted(rec["scores"], reverse=True)
 
     def test_gen_dalle_oov_raises(self, workdir):
         from dalle_pytorch_tpu.cli.gen_dalle import main
