@@ -27,9 +27,10 @@ ends with a HOST FETCH of a value data-dependent on the full computation
 ``scripts/axon_sync_repro.py`` is the committed repro of the platform
 behavior that forced this.
 
-Attention path: ``--attn xla|flash|flash_pallas`` (default flash on TPU —
-the Pallas kernel; flash_pallas adds the Pallas backward; auto-falls back
-to xla with a note if the kernel fails to compile).
+Attention path: ``--attn xla|flash|flash_pallas|flash_pallas_fused``
+(default flash on TPU — the Pallas kernel; flash_pallas adds the split
+Pallas backward, flash_pallas_fused the single-pass fused one; auto-falls
+back to xla with a note if the kernel fails to compile).
 
 Robustness (VERDICT r1): the axon TPU claim happens at interpreter start
 and can fail transiently ("UNAVAILABLE"). A failed claim poisons the
@@ -38,8 +39,8 @@ to --retries times with backoff; if all attempts fail it prints a
 DIAGNOSTIC JSON line (never a bare stack trace) and exits 1.
 
 Usage: python bench.py [--tiny] [--config all|north|vae|rev|sparse|moe|kernels]
-                       [--attn xla|flash|flash_pallas] [--steps N]
-                       [--batch B]
+                       [--attn xla|flash|flash_pallas|flash_pallas_fused]
+                       [--steps N] [--batch B]
 """
 
 import argparse
@@ -517,10 +518,13 @@ def build_cfg(tiny: bool, depth: int = 12, reversible: bool = False,
         raise ValueError(f"remat must be 'none', 'save_ln', 'dots' or "
                          f"'full', got {remat!r}")
 
-    # 'flash_pallas' = flash forward + the Pallas backward kernels
+    # 'flash_pallas' = flash forward + the split Pallas backward kernels;
+    # 'flash_pallas_fused' = flash forward + the single-pass fused bwd
     attn_bwd = "xla"
     if attn_impl == "flash_pallas":
         attn_impl, attn_bwd = "flash", "pallas"
+    elif attn_impl == "flash_pallas_fused":
+        attn_impl, attn_bwd = "flash", "pallas_fused"
 
     if tiny:
         vcfg = V.VAEConfig(image_size=16, num_tokens=32, codebook_dim=32,
@@ -1031,6 +1035,10 @@ def bench_kernels(args):
         return flash_attention(q, k, v, scale=scale, causal=True, mask=mask,
                                bwd_impl="pallas")
 
+    def flash_pallas_fused(q, k, v):
+        return flash_attention(q, k, v, scale=scale, causal=True, mask=mask,
+                               bwd_impl="pallas_fused")
+
     def dense_ref(q, k, v):
         w = dense_attention_weights(q, k, scale, mask, True)
         return jnp.einsum("bhij,bhjd->bhid", w, v)
@@ -1054,9 +1062,11 @@ def bench_kernels(args):
     ref_grads = {}                      # each O(n^2) reference bwd runs once
     for name, fn, ref in (("flash", flash, dense_ref),
                           ("flash_pallas_bwd", flash_pallas_bwd, dense_ref),
+                          ("flash_pallas_fused", flash_pallas_fused,
+                           dense_ref),
                           ("block_sparse", bs, bs_ref)):
         _progress(f"kernels: compiling {name}")
-        if name != "flash_pallas_bwd":
+        if not name.startswith("flash_pallas"):
             # bwd_impl only changes the custom_vjp backward — re-checking
             # the byte-identical forward would just pay a second compile
             o = jax.jit(fn)(q, k, v)
@@ -1211,7 +1221,8 @@ def main():
                     choices=["all", "north", "vae", "rev", "sparse", "moe",
                              "kernels"])
     ap.add_argument("--attn", default="auto",
-                    choices=["auto", "xla", "flash", "flash_pallas"],
+                    choices=["auto", "xla", "flash", "flash_pallas",
+                             "flash_pallas_fused"],
                     help="flash_pallas = flash forward + Pallas backward "
                          "kernels")
     ap.add_argument("--steps", type=int, default=20)

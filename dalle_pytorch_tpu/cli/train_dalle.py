@@ -95,9 +95,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attn_impl", type=str, default="xla",
                    choices=["xla", "flash"])
     p.add_argument("--attn_bwd_impl", type=str, default="xla",
-                   choices=["xla", "pallas"],
-                   help="flash backward: XLA blockwise scan or the Pallas "
-                        "kernels (causal tile skipping)")
+                   choices=["xla", "pallas", "pallas_fused"],
+                   help="flash backward: XLA blockwise scan, the split "
+                        "Pallas dq/dkv kernels (causal tile skipping), or "
+                        "the single-pass fused Pallas kernel (one score "
+                        "computation per tile pair)")
     p.add_argument("--sparse_impl", type=str, default="windowed",
                    choices=["ref", "windowed", "pallas"],
                    help="'windowed' is the exact fast path (block-diagonal "

@@ -59,7 +59,8 @@ class DALLEConfig:
     sparse_attn: Union[bool, Tuple[bool, ...]] = False
     sparse_block: int = 16
     attn_impl: str = "xla"
-    attn_bwd_impl: str = "xla"   # flash backward: 'xla' | 'pallas' kernels
+    # flash backward: 'xla' | 'pallas' (split) | 'pallas_fused' kernels
+    attn_bwd_impl: str = "xla"
     flash_block_q: int = 128     # flash kernel tile sizes (transformer cfg)
     flash_block_k: int = 128
     sparse_impl: str = "ref"

@@ -364,15 +364,39 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, seq_len,
     dq_ref[0] = lax.fori_loop(0, num_k, body, dq0).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_len,
-                    has_mask):
+def _bwd_keygrid_kernel(*refs, scale, causal, block_q, block_k, seq_len,
+                        has_mask, with_dq):
+    """Key-tile-gridded backward body, shared by the split dkv kernel
+    (``with_dq=False``) and the fused single-pass kernel
+    (``with_dq=True``).
+
+    Fused: dq, dk AND dv come from ONE score/probability computation per
+    (query-tile, key-tile) pair — the split dq/dkv pair recomputes s, p,
+    dp twice (7 MXU dots per pair vs 4 here), which is the structural
+    reason it measured SLOWER than the XLA blockwise scan in r4 (147.4
+    vs 126.9 ms, docs/PROFILE_NORTH.json). Grid is (bh, key-tile) with
+    ik innermost; the full-length dq block's index map ignores ik, so on
+    TPU's sequential grid the block stays resident in VMEM across all
+    key tiles of one bh (output revisiting) and row tiles accumulate in
+    f32 via read-modify-write. dk/dv are per-ik tile outputs either
+    way."""
     if has_mask:
-        (mq_ref, mk_ref, q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
-         dk_ref, dv_ref) = refs
+        mq_ref, mk_ref, *refs = refs
+    else:
+        mq_ref = mk_ref = None
+    if with_dq:
+        (q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
+         dq_ref, dk_ref, dv_ref) = refs
     else:
         (q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
          dk_ref, dv_ref) = refs
     ik = pl.program_id(1)
+
+    if with_dq:
+        @pl.when(ik == 0)
+        def _zero_dq():
+            dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
     kb = k_ref[0]                                          # (BK, d)
     vb = v_ref[0]                                          # (BK, d)
     cols = ik * block_k + lax.broadcasted_iota(
@@ -407,9 +431,15 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_len,
         ds = p * (dp - dstat) * scale
         if live is not None:
             ds = jnp.where(live, ds, 0.0)
+        ds_c = ds.astype(qb.dtype)
         dk = dk + jax.lax.dot_general(
-            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            ds_c, qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if with_dq:
+            dq_rows = dq_ref[0, pl.ds(iq * block_q, block_q), :]
+            dq_ref[0, pl.ds(iq * block_q, block_q), :] = dq_rows + \
+                jax.lax.dot_general(ds_c, kb, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
         return dk, dv
 
     dk0 = jnp.zeros((block_k, q_ref.shape[-1]), jnp.float32)
@@ -419,10 +449,17 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, seq_len,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+_bwd_dkv_kernel = functools.partial(_bwd_keygrid_kernel, with_dq=False)
+_bwd_fused_kernel = functools.partial(_bwd_keygrid_kernel, with_dq=True)
+
+
 def _pallas_attention_bwd(q, k, v, mask, dout, out, softmax_stats, *,
-                          scale, causal, block_q, block_k, interpret):
+                          scale, causal, block_q, block_k, interpret,
+                          fused: bool = False):
     """Pallas counterpart of ``blockwise_attention_bwd`` (dense/causal/pad
-    only — the sparse layout keeps the XLA blockwise path)."""
+    only — the sparse layout keeps the XLA blockwise path). ``fused``
+    selects the single-pass kernel (_bwd_fused_kernel) over the split
+    dq/dkv pair."""
     m_stat, l_stat = softmax_stats
     b, h, n_orig, d = q.shape
     mult = max(block_q, block_k)
@@ -457,6 +494,42 @@ def _pallas_attention_bwd(q, k, v, mask, dout, out, softmax_stats, *,
     tile_q = lambda ib, i: (ib, i, 0)                  # noqa: E731
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, seq_len=n_orig, has_mask=has_mask)
+
+    if fused:
+        # one pass: grid over key tiles, dq as a full-length revisited
+        # block (index map ignores ik -> stays VMEM-resident per bh on
+        # the sequential TPU grid), f32 row-tile accumulation in-kernel
+        tile_k2 = lambda ib, i: (ib, i, 0)             # noqa: E731
+        in_specs = []
+        if has_mask:
+            in_specs += [pl.BlockSpec((1, n, NUM_LANES),
+                                      lambda ib, i: (ib // h, 0, 0)),
+                         mk_spec]
+        in_specs += [
+            pl.BlockSpec((1, n, d), full),             # q full
+            pl.BlockSpec((1, block_k, d), tile_k2),    # k tile
+            pl.BlockSpec((1, block_k, d), tile_k2),    # v tile
+            pl.BlockSpec((1, n, d), full),             # dout full
+            pl.BlockSpec((1, n, NUM_LANES), full),     # m
+            pl.BlockSpec((1, n, NUM_LANES), full),     # l
+            pl.BlockSpec((1, n, NUM_LANES), full),     # D
+        ]
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, **common),
+            grid=(bh, pl.cdiv(n, block_k)),
+            in_specs=in_specs,
+            out_specs=[pl.BlockSpec((1, n, d), full),
+                       pl.BlockSpec((1, block_k, d), tile_k2),
+                       pl.BlockSpec((1, block_k, d), tile_k2)],
+            out_shape=[jax.ShapeDtypeStruct((bh, n, d), jnp.float32),
+                       jax.ShapeDtypeStruct((bh, n, d), k.dtype),
+                       jax.ShapeDtypeStruct((bh, n, d), v.dtype)],
+            interpret=interpret,
+        )(*mask_inputs, qf, kf, vf, dof, *stats)
+        dq = dq.astype(q.dtype).reshape(b, h, n, d)[:, :, :n_orig]
+        dk = dk.reshape(b, h, n, d)[:, :, :n_orig]
+        dv = dv.reshape(b, h, n, d)[:, :, :n_orig]
+        return dq, dk, dv
 
     # dq: grid over query tiles
     in_specs = []
@@ -536,11 +609,12 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, bwd_impl,
                     res, dout):
     q, k, v, mask, out, stats = res
 
-    if bwd_impl == "pallas":
+    if bwd_impl in ("pallas", "pallas_fused"):
         dq, dk, dv = _pallas_attention_bwd(
             q, k, v, mask, dout, out, stats, scale=scale, causal=causal,
             block_q=min(block_q, q.shape[2]),
-            block_k=min(block_k, q.shape[2]), interpret=interpret)
+            block_k=min(block_k, q.shape[2]), interpret=interpret,
+            fused=bwd_impl == "pallas_fused")
         return dq, dk, dv, None
 
     def structural(rows, cols):
@@ -568,14 +642,18 @@ def flash_attention(q: Array, k: Array, v: Array, *,
     q/k/v: (b, h, n, d); mask: (b, n) True=keep. ``interpret=None``
     auto-selects the Pallas interpreter off-TPU so the same code path runs
     on the CPU test mesh. ``bwd_impl='pallas'`` swaps the XLA blockwise
-    backward for the Pallas kernels (causal-dead tiles skipped, VMEM
-    intermediates) — opt-in until compiled-mode numbers are recorded.
+    backward for the split dq/dkv Pallas kernels (causal-dead tiles
+    skipped, VMEM intermediates); ``'pallas_fused'`` uses the
+    single-pass kernel (one score computation per tile pair, dq
+    accumulated in a VMEM-resident revisited block — 4 MXU dots per
+    pair vs the split pair's 7). Both opt-in until compiled-mode
+    numbers decide a default.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if bwd_impl not in ("xla", "pallas"):
+    if bwd_impl not in ("xla", "pallas", "pallas_fused"):
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
     n = q.shape[2]
     return _flash(q, k, v, mask, float(scale), bool(causal),

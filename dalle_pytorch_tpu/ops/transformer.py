@@ -57,8 +57,10 @@ class TransformerConfig:
     sparse_attn: Union[bool, Tuple[bool, ...]] = False
     sparse_block: int = 16
     attn_impl: str = "xla"      # 'xla' | 'flash'
-    # flash backward: 'xla' blockwise scan | 'pallas' kernels (causal tile
-    # skipping); only meaningful with attn_impl='flash'
+    # flash backward: 'xla' blockwise scan | 'pallas' split dq/dkv kernels
+    # (causal tile skipping) | 'pallas_fused' single-pass kernel (one
+    # score computation per tile pair); only meaningful with
+    # attn_impl='flash'
     attn_bwd_impl: str = "xla"
     # flash kernel tile sizes (q rows x k cols per grid step); multiples of
     # the (8, 128) TPU register tile. Tunable: larger k tiles amortize the

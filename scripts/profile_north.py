@@ -141,7 +141,7 @@ def main():
 
     # -- attention fwd+bwd, all impls, one layer x depth -------------------
     x = jax.random.normal(key, (b, h_dim, n, dh), dt)
-    for impl in ("flash", "flash_pallas_bwd", "xla"):
+    for impl in ("flash", "flash_pallas_bwd", "flash_pallas_fused", "xla"):
         if impl == "xla":
             # dense attention materializes (b,h,n,n) f32 weights. One
             # layer in isolation fits at the tuned batches (b=16 is
@@ -159,9 +159,10 @@ def main():
         note(f"attn impl={impl}")
         if impl.startswith("flash"):
             from dalle_pytorch_tpu.ops.flash_attention import flash_attention
+            bwd = {"flash_pallas_bwd": "pallas",
+                   "flash_pallas_fused": "pallas_fused"}.get(impl, "xla")
             att = functools.partial(
-                flash_attention, causal=True, scale=d ** -0.5,
-                bwd_impl="pallas" if impl.endswith("pallas_bwd") else "xla")
+                flash_attention, causal=True, scale=d ** -0.5, bwd_impl=bwd)
         else:
             def att(q, k, v):
                 w = attn_ops.dense_attention_weights(q, k, d ** -0.5, None,

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Round-5 profile leg: waits for the banking agenda and the demo leg,
+# then re-runs the step-decomposition profiler — now including the
+# fused single-pass Pallas flash backward (bwd_impl='pallas_fused') —
+# so docs/PROFILE_NORTH.json records whether the fused kernel finally
+# beats the XLA blockwise backward (VERDICT r4 item 3's flash half).
+#   nohup bash scripts/r5_profile.sh > /tmp/r5_profile.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+. scripts/window_lib.sh
+
+while pgrep -f 'scripts/r5_(agenda|demo)\.sh' > /dev/null; do
+  echo "[$(stamp)] earlier r5 legs still running; waiting 120s"
+  sleep 120
+done
+
+wait_healthy_tunnel
+echo "[$(stamp)] == profile_north (with pallas_fused) =="
+python scripts/profile_north.py && echo "[$(stamp)] profile OK" \
+  || echo "[$(stamp)] profile FAILED"
+echo "[$(stamp)] r5 profile leg complete — inspect docs/PROFILE_NORTH.json"

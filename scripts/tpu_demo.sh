@@ -61,9 +61,11 @@ fi
 mstamp="$MODELS/.demo_stamp_${IMG_SIZE}_${DIM}_${DEPTH}_${TOKENS}_${CDIM}_${HID}_${LAYERS}"
 mkdir -p "$MODELS"
 if [ ! -f "$mstamp" ]; then
-  rm -rf "$MODELS"/demovae-* "$MODELS"/demodalle_dalle-* "$MODELS"/democfg_dalle-* "$MODELS"/.demo_stamp_*
+  rm -rf "$MODELS"/demovae-* "$MODELS"/demodalle_dalle-* \
+         "$MODELS"/democfg_dalle-* "$MODELS"/democlip-* \
+         "$MODELS"/.demo_stamp_*
   rm -f "$OUT/vae_loss.jsonl" "$OUT/dalle_loss.jsonl" \
-        "$OUT/cfg_loss.jsonl"                          # curves restart too
+        "$OUT/cfg_loss.jsonl" "$OUT/clip_loss.jsonl"   # curves restart too
   touch "$mstamp"
 fi
 

@@ -372,29 +372,33 @@ class TestPallasBackward:
 
         return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 
+    @pytest.mark.parametrize("impl", ["pallas", "pallas_fused"])
     @pytest.mark.parametrize("causal", [True, False])
-    def test_matches_xla_bwd(self, key, causal):
-        gp = self._grads(key, "pallas", causal=causal)
+    def test_matches_xla_bwd(self, key, causal, impl):
+        gp = self._grads(key, impl, causal=causal)
         gx = self._grads(key, "xla", causal=causal)
         for a, b in zip(gp, gx):
             np.testing.assert_allclose(np.array(a), np.array(b), atol=2e-4)
 
-    def test_with_pad_mask(self, key):
+    @pytest.mark.parametrize("impl", ["pallas", "pallas_fused"])
+    def test_with_pad_mask(self, key, impl):
         mask = jnp.ones((2, 256), bool).at[0, 200:].set(False) \
                                        .at[1, 10:].set(False)
-        gp = self._grads(key, "pallas", mask=mask)
+        gp = self._grads(key, impl, mask=mask)
         gx = self._grads(key, "xla", mask=mask)
         for a, b in zip(gp, gx):
             np.testing.assert_allclose(np.array(a), np.array(b), atol=2e-4)
 
-    def test_ragged_seq(self, key):
-        gp = self._grads(key, "pallas", n=192)   # pads to 256-tile inside
+    @pytest.mark.parametrize("impl", ["pallas", "pallas_fused"])
+    def test_ragged_seq(self, key, impl):
+        gp = self._grads(key, impl, n=192)   # pads to 256-tile inside
         gx = self._grads(key, "xla", n=192)
         for a, b in zip(gp, gx):
             np.testing.assert_allclose(np.array(a), np.array(b), atol=2e-4)
 
-    def test_bf16_finite(self, key):
-        gp = self._grads(key, "pallas", dtype=jnp.bfloat16)
+    @pytest.mark.parametrize("impl", ["pallas", "pallas_fused"])
+    def test_bf16_finite(self, key, impl):
+        gp = self._grads(key, impl, dtype=jnp.bfloat16)
         for g in gp:
             assert g.dtype == jnp.bfloat16
             assert np.isfinite(np.array(g, dtype=np.float32)).all()
