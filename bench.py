@@ -1114,26 +1114,33 @@ def bench_kernels(args):
             return sparse_attention_windowed(q, k, v, scale=64 ** -0.5,
                                              causal=True)
 
-        times = {}
-        for name, fn in (("pallas", bs_big), ("ref", bs_ref_big),
-                         ("windowed", bs_win_big)):
-            _progress(f"kernels: timing sparse {name} fwd+bwd @ seq {ns}")
-            step = jax.jit(jax.grad(sq_loss(fn), argnums=(0, 1, 2)))
-            g = step(q2, k2, v2)
-            _fetch(g[0])                      # compile + warm
-            t0 = time.perf_counter()
-            x = q2
-            for _ in range(steps):
-                g = step(x, k2, v2)
-                x = q2 + 0.0 * g[0].astype(q2.dtype)   # chain dependence
-            _fetch(g[0])
-            times[name] = (time.perf_counter() - t0) / steps * 1e3
-        out["sparse_attn_ms"] = {kk_: round(tv, 3)
-                                 for kk_, tv in times.items()}
-        out["sparse_pallas_vs_ref_isolated"] = round(
-            times["ref"] / times["pallas"], 3)
-        out["sparse_pallas_vs_windowed_isolated"] = round(
-            times["windowed"] / times["pallas"], 3)
+        # timing is supplementary — a failure here (OOM at an untested
+        # shape, transient tunnel hiccup) must degrade to a note, never
+        # fail the parity config the driver's bench depends on
+        try:
+            times = {}
+            for name, fn in (("pallas", bs_big), ("ref", bs_ref_big),
+                             ("windowed", bs_win_big)):
+                _progress(f"kernels: timing sparse {name} fwd+bwd "
+                          f"@ seq {ns}")
+                step = jax.jit(jax.grad(sq_loss(fn), argnums=(0, 1, 2)))
+                g = step(q2, k2, v2)
+                _fetch(g[0])                      # compile + warm
+                t0 = time.perf_counter()
+                x = q2
+                for _ in range(steps):
+                    g = step(x, k2, v2)
+                    x = q2 + 0.0 * g[0].astype(q2.dtype)  # chain dependence
+                _fetch(g[0])
+                times[name] = (time.perf_counter() - t0) / steps * 1e3
+            out["sparse_attn_ms"] = {kk_: round(tv, 3)
+                                     for kk_, tv in times.items()}
+            out["sparse_pallas_vs_ref_isolated"] = round(
+                times["ref"] / times["pallas"], 3)
+            out["sparse_pallas_vs_windowed_isolated"] = round(
+                times["windowed"] / times["pallas"], 3)
+        except Exception as e:
+            out["sparse_timing_error"] = f"{type(e).__name__}: {e}"[:300]
     return out
 
 

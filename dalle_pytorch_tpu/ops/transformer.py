@@ -330,7 +330,10 @@ def transformer_apply(params: dict, x: Array, *, cfg: TransformerConfig,
     keys = _layer_keys(rng, cfg.depth)
     pattern = cfg.sparse_pattern
     layout = unrolled_layout(params, keys, pattern)
-    aux0 = jnp.float32(0.0)
+    # The MoE aux is collected as a scan OUTPUT (summed after), not a
+    # carry: under shard_map the per-layer aux can be varying over mesh
+    # axes the zero init isn't, and outputs have no carry-type constraint
+    # (carries would need a pcast this module can't know the axes for).
 
     if layout is not None:
         # Periodic dense/sparse patterns (the reference's (True, False)*32,
@@ -341,9 +344,9 @@ def transformer_apply(params: dict, x: Array, *, cfg: TransformerConfig,
         # super-layer regardless of depth.
         stacked, keys_r, period_pat = layout
 
-        def body(carry, xs):
+        def body(h, xs):
             lp, lkeys = xs
-            h, aux = carry
+            aux = jnp.float32(0.0)
             for i, is_sparse in enumerate(period_pat):
                 lpi = jax.tree.map(lambda a: a[i], lp)
                 h = h + attn_branch(lpi, h, mask, cfg, bool(is_sparse),
@@ -351,21 +354,20 @@ def transformer_apply(params: dict, x: Array, *, cfg: TransformerConfig,
                 f, a = ff_or_moe(lpi, h, cfg, lkeys[i][1], train)
                 h = h + f
                 aux = aux + a
-            return (h, aux), None
+            return h, aux
 
         body = _maybe_remat(body, cfg.remat)
-        (out, aux), _ = lax.scan(body, (x, aux0), (stacked, keys_r))
-        return (out, aux) if with_aux else out
+        out, auxs = lax.scan(body, x, (stacked, keys_r))
+        return (out, auxs.sum()) if with_aux else out
 
     sparse_flags = jnp.asarray(pattern)
 
-    def body(carry, xs):
+    def body(h, xs):
         lp, lkeys, is_sparse = xs
-        h, aux = carry
         h = h + attn_branch(lp, h, mask, cfg, is_sparse, lkeys[0], train)
         f, a = ff_or_moe(lp, h, cfg, lkeys[1], train)
-        return (h + f, aux + a), None
+        return h + f, a
 
     body = _maybe_remat(body, cfg.remat)
-    (out, aux), _ = lax.scan(body, (x, aux0), (params, keys, sparse_flags))
-    return (out, aux) if with_aux else out
+    out, auxs = lax.scan(body, x, (params, keys, sparse_flags))
+    return (out, auxs.sum()) if with_aux else out

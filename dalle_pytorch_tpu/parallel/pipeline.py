@@ -17,7 +17,11 @@ reverse rotation.
 
 Composes with data parallelism by sharding the microbatch dimension over a
 ``dp`` axis of the same mesh (``dp_axis=``); tensor/sequence parallelism
-apply within a stage exactly as without pp.
+apply within a stage exactly as without pp. MoE layers compose too (r5):
+the tick scan threads the Switch load-balance aux through to the loss,
+and ``pp_param_specs(ep=...)`` shards each stage's expert stacks over an
+``ep`` mesh axis that rides the shard_map as a GSPMD auto axis —
+dp x pp x ep in one program (dryrun-proven with loss parity).
 """
 
 from __future__ import annotations
@@ -30,10 +34,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map            # jax >= 0.8
-except ImportError:                      # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# jax >= 0.8 required (pyproject pin): shard_map(axis_names=...) keeps
+# non-pipeline mesh axes (e.g. 'ep') as GSPMD auto axes
+from jax import shard_map
 
 Array = jax.Array
 
@@ -59,7 +62,8 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
                          num_microbatches: Optional[int] = None,
                          dp_axis: Optional[str] = None,
                          mask: Optional[Array] = None,
-                         rng=None, train: bool = False) -> Array:
+                         rng=None, train: bool = False,
+                         with_aux: bool = False):
     """Run the transformer stack pipelined over ``mesh.shape[axis]`` stages.
 
     params: depth-stacked layer tree (leading axis ``cfg.depth``).
@@ -90,10 +94,6 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
         # function; pp + reversible is a future combination
         raise NotImplementedError(
             "pipeline_transformer does not support reversible=True")
-    if cfg.moe_experts:
-        raise NotImplementedError(
-            "pipeline_transformer does not support MoE layers (the aux "
-            "loss is not threaded through the tick scan)")
     dropout_on = train and (cfg.attn_dropout > 0 or cfg.ff_dropout > 0)
     if dropout_on and rng is None:
         raise ValueError(
@@ -145,45 +145,83 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
 
             def run(h):
                 return transformer_apply(sp, h, cfg=stage_cfg, mask=m,
-                                         rng=key_mb, train=train)
+                                         rng=key_mb, train=train,
+                                         with_aux=True)
 
             # ramp-up/down ticks where this stage holds no microbatch skip
             # the layer slice entirely (identity); the ppermute below runs
             # unconditionally so the collective stays program-aligned
             active = jnp.logical_and(mb_idx >= 0, mb_idx < M)
-            out = lax.cond(active, run, lambda h: h, h)
+            # the idle branch's zero aux must carry the same varying axes
+            # as the active branch's: a real MoE aux inherits (pp, dp)
+            # from the activations, while the dense stack's aux is a
+            # literal 0.0 constant (non-varying) — match each case
+            if cfg.moe_experts:
+                zero_aux = lax.pcast(
+                    jnp.float32(0.0),
+                    tuple(a for a in (axis, dp_axis) if a is not None),
+                    to="varying")
+            else:
+                zero_aux = jnp.float32(0.0)
+            out, aux = lax.cond(active, run, lambda h: (h, zero_aux), h)
             nxt = lax.ppermute(out, axis,
                                [(i, (i + 1) % P_) for i in range(P_)])
-            return nxt, out
+            return nxt, (out, aux)
 
         # the carry is device-varying over pp (each stage holds a different
         # microbatch's activations) — mark the zero init accordingly
         state0 = lax.pcast(jnp.zeros_like(xm[0]), (axis,), to="varying")
-        _, outs = lax.scan(tick, state0,
-                           (jnp.arange(ticks), stream[:ticks], masks))
+        _, (outs, auxs) = lax.scan(tick, state0,
+                                   (jnp.arange(ticks), stream[:ticks],
+                                    masks))
         # stage s finishes microbatch m at tick m + s: the last stage's
         # outputs at ticks P-1 .. M+P-2 are the final activations, in order
         final = outs[P_ - 1:]
         final = jnp.where(idx == P_ - 1, final, jnp.zeros_like(final))
-        return lax.psum(final, axis)                      # select last stage
+        # MoE load-balance aux: every stage contributes its layer slice's
+        # aux for each ACTIVE tick (idle ticks contribute the cond's 0).
+        # Match the dense path's normalization (one batch-wide MEAN per
+        # layer, summed over layers — moe.py:124): sum stages via psum
+        # over pp, average the M microbatch means, and pmean over dp so
+        # the scalar leaves the shard_map replicated
+        aux_total = lax.psum(auxs.sum(), axis) / M
+        if dp_axis is not None:
+            aux_total = lax.pmean(aux_total, dp_axis)
+        return lax.psum(final, axis), aux_total           # select last stage
 
     data_spec = P(None, dp_axis) if dp_axis else P()
     mask_spec = data_spec if has_mask else P()    # placeholder: replicate
-    out = shard_map(stage_fn, mesh=mesh,
-                    in_specs=(P(axis), data_spec, mask_spec, P()),
-                    out_specs=data_spec)(stacked, xm, maskm, rng)
-    return out.reshape(b, n, d)
+    # manual only over pp (+ dp for the data specs): any OTHER mesh axis
+    # (e.g. 'ep' sharding each stage's expert stacks) stays a GSPMD auto
+    # axis and composes without this file knowing it exists — the same
+    # partial-manual discipline as parallel.sequence
+    manual = frozenset(a for a in (axis, dp_axis) if a is not None)
+    out, aux = shard_map(stage_fn, mesh=mesh,
+                         in_specs=(P(axis), data_spec, mask_spec, P()),
+                         out_specs=(data_spec, P()),
+                         axis_names=manual)(stacked, xm, maskm, rng)
+    out = out.reshape(b, n, d)
+    return (out, aux) if with_aux else out
 
 
-def pp_param_specs(params, axis: str = "pp"):
+def pp_param_specs(params, axis: str = "pp", ep: Optional[str] = None):
     """PartitionSpecs that shard the depth-stacked transformer over the
     pipeline axis (each stage stores only its own depth/P layer slice; the
     contiguous leading-axis shard is exactly the stage-major reshape inside
     ``pipeline_transformer``) and replicate everything else. Feed to
-    ``parallel.train.setup_sharded(param_specs=...)``."""
-    return {k: (jax.tree.map(lambda _: P(axis), v) if k == "transformer"
-                else jax.tree.map(lambda _: P(), v))
-            for k, v in params.items()}
+    ``parallel.train.setup_sharded(param_specs=...)``.
+
+    ``ep`` additionally shards the MoE expert axis of each stage's layer
+    slice over that mesh axis — dp x pp x ep in one program (the expert
+    axis is a GSPMD auto axis inside the pipeline's shard_map)."""
+    specs = {k: (jax.tree.map(lambda _: P(axis), v) if k == "transformer"
+                 else jax.tree.map(lambda _: P(), v))
+             for k, v in params.items()}
+    if ep is not None and "moe" in specs["transformer"].get("ff", {}):
+        moe = specs["transformer"]["ff"]["moe"]
+        moe["w1"] = P(axis, ep)          # (depth, E, dim, hidden)
+        moe["w2"] = P(axis, ep)
+    return specs
 
 
 def pp_dalle_loss_fn(cfg, mesh: Mesh, *, axis: str = "pp",
@@ -212,12 +250,19 @@ def pp_dalle_loss_fn(cfg, mesh: Mesh, *, axis: str = "pp",
         if mask is not None:
             pad = jnp.ones((mask.shape[0], image_ids.shape[1]), bool)
             mask = jnp.concatenate([mask, pad], axis=1)
-        h = pipeline_transformer(params["transformer"], tokens,
-                                 cfg=cfg.transformer, mesh=mesh, axis=axis,
-                                 dp_axis=dp_axis,
-                                 num_microbatches=num_microbatches,
-                                 mask=mask, rng=rng, train=True)
+        h, aux = pipeline_transformer(params["transformer"], tokens,
+                                      cfg=cfg.transformer, mesh=mesh,
+                                      axis=axis, dp_axis=dp_axis,
+                                      num_microbatches=num_microbatches,
+                                      mask=mask, rng=rng, train=True,
+                                      with_aux=True)
         # same loss tail as dalle_apply — one definition of the contract
-        return D.ce_from_hidden(params, h, text, image_ids, cfg=cfg)
+        loss_val = D.ce_from_hidden(params, h, text, image_ids, cfg=cfg)
+        if cfg.moe_experts:
+            # GPipe sums aux over stages x microbatches; dalle_apply's
+            # dense scan sums over layers for the whole batch — same
+            # total, same coefficient (models/dalle.py:281-282)
+            loss_val = loss_val + cfg.moe_aux_coef * aux
+        return loss_val
 
     return loss

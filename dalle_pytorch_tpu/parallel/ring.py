@@ -34,10 +34,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map            # jax >= 0.8
-except ImportError:                      # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# jax >= 0.8 required (pyproject pin) — same discipline as
+# parallel.sequence / parallel.pipeline
+from jax import shard_map
 
 
 def _online_block(carry, kb, vb, q, scale, allow, pair_ok=None):

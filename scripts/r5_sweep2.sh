@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Round-5 second sweep wave: after the profile leg, measure the FUSED
+# Pallas flash backward inside the full train step at the tuned batch
+# points (the r4 sweep measured flash_pallas split-bwd only), then
+# re-record the bench if anything moved the best.
+#   nohup bash scripts/r5_sweep2.sh > /tmp/r5_sweep2.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+. scripts/window_lib.sh
+
+while pgrep -f 'scripts/r5_(agenda|demo|profile)\.sh' > /dev/null; do
+  echo "[$(stamp)] earlier r5 legs still running; waiting 120s"
+  sleep 120
+done
+
+wait_healthy_tunnel
+best_before=$(tuned_best)
+echo "[$(stamp)] == fused-bwd sweep (best so far: $best_before) =="
+python scripts/tune_north.py --attns flash_pallas_fused --batches 8,16 \
+  --loss_chunks 256 --claim_retries 3 \
+  && echo "[$(stamp)] fused leg OK" || echo "[$(stamp)] fused leg FAILED"
+rebench_if_improved "$best_before" s2
+echo "[$(stamp)] r5 sweep-2 leg complete"

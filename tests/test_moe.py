@@ -158,9 +158,12 @@ def test_dalle_moe_loss_and_generation(key):
     assert np.isfinite(np.asarray(images)).all()
 
 
-def test_sp_pp_reject_moe(key):
-    import dataclasses
+def test_sp_rejects_moe_pp_accepts(key):
+    """sp still excludes MoE (route tokens before sharding them); pp
+    composes with it since r5 (aux threaded through the tick scan) — a
+    pipelined MoE stack must match the single-device one."""
     from dalle_pytorch_tpu.ops.transformer import (TransformerConfig,
+                                                   transformer_apply,
                                                    transformer_init)
     from dalle_pytorch_tpu.parallel import (make_mesh, pipeline_transformer,
                                             sp_transformer_apply)
@@ -172,8 +175,12 @@ def test_sp_pp_reject_moe(key):
     with pytest.raises(ValueError, match="MoE"):
         sp_transformer_apply(params, x, cfg=cfg, mesh=mesh)
     mesh2 = make_mesh({"pp": 2}, jax.devices()[:2])
-    with pytest.raises(NotImplementedError, match="MoE"):
-        pipeline_transformer(params, x, cfg=cfg, mesh=mesh2)
+    y_pp, aux_pp = jax.jit(lambda p, x: pipeline_transformer(
+        p, x, cfg=cfg, mesh=mesh2, with_aux=True))(params, x)
+    y_ref, aux_ref = transformer_apply(params, x, cfg=cfg, with_aux=True)
+    np.testing.assert_allclose(np.asarray(y_pp), np.asarray(y_ref),
+                               atol=2e-5)
+    np.testing.assert_allclose(float(aux_pp), float(aux_ref), rtol=1e-5)
 
 
 def test_torch_export_rejects_moe(key):
