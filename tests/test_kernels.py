@@ -177,6 +177,24 @@ def test_flash_gradients_ragged_seq(key):
         np.testing.assert_allclose(np.array(a), np.array(b), atol=5e-4)
 
 
+def test_static_tile_schedule_selection():
+    """The schedule factorization itself (r5): exactly which layouts
+    admit the python-unrolled tile list, and which fall back."""
+    from dalle_pytorch_tpu.ops.block_sparse import _static_tile_schedule
+    # the default VariableSparsity layout: diagonal + global tile 0
+    assert _static_tile_schedule(128, 128, 16, 64, (0,), True) == [0]
+    # multiple global blocks in distinct tiles
+    assert _static_tile_schedule(128, 128, 16, 64, (0, 8), True) == [0, 1]
+    # non-causal, mismatched tiles, window not dividing: all fall back
+    assert _static_tile_schedule(128, 128, 16, 64, (0,), False) is None
+    assert _static_tile_schedule(64, 128, 16, 64, (0,), True) is None
+    assert _static_tile_schedule(96, 96, 16, 64, (0,), True) is None
+    # a global block straddling a tile boundary falls back (window 16
+    # divides the 64 tile, so this reaches the straddle check itself:
+    # block 48, g=1 spans tokens 48..95 = tiles 0 and 1)
+    assert _static_tile_schedule(64, 64, 48, 16, (1,), True) is None
+
+
 def test_block_sparse_gradients_masked_static_schedule(key):
     """Grads through the STATIC-schedule backward (r5: diagonal piece +
     global strip instead of the key-tile scan) with a pad-key mask —
