@@ -52,15 +52,26 @@ def _write_merged(results, out=None):
 
 def run_point(impl, seq, depth, batch, steps, warmup):
     """tokens/sec for fwd+bwd through a depth-layer stack at (batch, seq),
-    or raises (caller classifies OOM vs error)."""
+    or raises (caller classifies OOM vs error).
+
+    ``impl`` 'xla'/'flash' compare the SAME dense attention (the memory
+    crossover); 'sparse_windowed' runs the VariableSparsity stack via the
+    windowed decomposition instead — a different (sparse) attention
+    function, recorded as the long-context capability of the sparse
+    training path, not as a dense-attention comparison point."""
     import jax
     import jax.numpy as jnp
 
     from dalle_pytorch_tpu.ops.transformer import (TransformerConfig,
                                                    transformer_apply,
                                                    transformer_init)
-    cfg = TransformerConfig(dim=512, depth=depth, seq_len=seq,
-                            attn_impl=impl, causal=True)
+    if impl == "sparse_windowed":
+        cfg = TransformerConfig(dim=512, depth=depth, seq_len=seq,
+                                causal=True, sparse_attn=True,
+                                sparse_impl="windowed")
+    else:
+        cfg = TransformerConfig(dim=512, depth=depth, seq_len=seq,
+                                attn_impl=impl, causal=True)
     params = transformer_init(jax.random.PRNGKey(0), cfg, jnp.bfloat16)
     x = jax.random.normal(jax.random.PRNGKey(1), (batch, seq, 512),
                           jnp.bfloat16)

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Round-5 final leg: extend docs/LONGCTX.json with the sparse-windowed
+# stack at the same long sequence lengths — the committed record that
+# the SPARSE training path (the depth-64 config's attention) also
+# sustains long context where the dense xla path OOMs. Runs after every
+# other r5 leg.
+#   nohup bash scripts/r5_longctx2.sh > /tmp/r5_longctx2.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+. scripts/window_lib.sh
+
+while pgrep -f 'scripts/r5_(agenda|demo|profile|sweep2)\.sh' > /dev/null; do
+  echo "[$(stamp)] earlier r5 legs still running; waiting 120s"
+  sleep 120
+done
+
+wait_healthy_tunnel
+echo "[$(stamp)] == long-context probe: sparse_windowed =="
+python scripts/longctx_probe.py --seqs 2560,5120,10240 \
+  --impls sparse_windowed \
+  && echo "[$(stamp)] sparse longctx OK" \
+  || echo "[$(stamp)] sparse longctx FAILED"
+echo "[$(stamp)] r5 longctx-2 leg complete"
