@@ -1,0 +1,42 @@
+# Canonical invocations for dalle_pytorch_tpu development.
+#
+# CPU targets prefix PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu so they never
+# block on the TPU tunnel claim (see docs/TPU_OUTAGE_2026-07-30.md); chip
+# targets use the plain environment and expect a healthy tunnel.
+
+# No XLA_FLAGS device forcing here: tests/conftest.py and
+# __graft_entry__.dryrun_multichip set up the 8-device CPU mesh themselves
+CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+
+.PHONY: test test-fast dryrun bench-smoke bench demo-rehearsal demo lint
+
+test:            ## full suite on the virtual 8-device CPU mesh (~25 min)
+	$(CPU_ENV) python -m pytest tests/ -q
+
+test-fast:       ## kernels + transformer + parallel only (~5 min)
+	$(CPU_ENV) python -m pytest tests/test_kernels.py \
+	    tests/test_transformer.py tests/test_parallel.py -q
+
+dryrun:          ## the driver's multi-chip validation (8 virtual devices)
+	$(CPU_ENV) python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench-smoke:     ## tiny CPU bench — structural check of every config
+	$(CPU_ENV) XLA_FLAGS= python bench.py --tiny --steps 2 --warmup 1 \
+	    --gen_reps 1
+
+bench:           ## full bench on the real chip (healthy tunnel required)
+	python bench.py
+
+demo-rehearsal:  ## end-to-end demo pipeline, tiny knobs, scratch dirs
+	$(CPU_ENV) OUT=/tmp/demo_rehearsal/out DATA=/tmp/demo_rehearsal/data \
+	    MODELS=/tmp/demo_rehearsal/models IMG_N=48 IMG_SIZE=32 \
+	    VAE_EPOCHS=1 DALLE_EPOCHS=1 CFG_EPOCHS=1 CLIP_EPOCHS=1 DIM=32 \
+	    DEPTH=2 TOKENS=64 CDIM=32 HID=16 LAYERS=2 bash scripts/tpu_demo.sh
+
+demo:            ## the real trained demo on the chip
+	bash scripts/tpu_demo.sh
+
+lint:            ## syntax-check every python file and orchestrator script
+	$(CPU_ENV) python -m compileall -q dalle_pytorch_tpu tests scripts \
+	    bench.py __graft_entry__.py
+	for f in scripts/*.sh; do bash -n $$f || exit 1; done
