@@ -31,6 +31,7 @@ import os
 import re
 import shutil
 import tempfile
+import zlib
 from typing import Any, Optional, Tuple
 
 import jax
@@ -75,23 +76,37 @@ def save(path: str, params, *, step: int = 0, config: Any = None,
     os.makedirs(parent, exist_ok=True)
     tmp = tempfile.mkdtemp(dir=parent, prefix=".ckpt-tmp-")
     try:
+        payloads = {}
+
+        def write_payload(fname: str, data: bytes) -> None:
+            with open(os.path.join(tmp, fname), "wb") as f:
+                f.write(data)
+            # size + crc32 recorded in the manifest let ``validate`` prove
+            # integrity WITHOUT msgpack-decoding multi-GB payloads twice
+            payloads[fname] = {"bytes": len(data),
+                               "crc32": zlib.crc32(data)}
+
+        write_payload(PARAMS, serialization.msgpack_serialize(
+            _to_host(params)))
+        if opt_state is not None:
+            write_payload(OPT_STATE, serialization.to_bytes(
+                _to_host(opt_state)))
+        if ema is not None:
+            write_payload(EMA, serialization.msgpack_serialize(
+                _to_host(ema)))
         manifest = {
             "kind": kind,
             "step": int(step),
             "config": _config_dict(config) if config is not None else None,
             "meta": meta or {},
+            "payloads": payloads,
             "format": 1,
         }
+        # manifest LAST: its presence then implies every payload above it
+        # was fully written (tmp-dir scope; the rename below makes the
+        # whole directory visible atomically either way)
         with open(os.path.join(tmp, MANIFEST), "w") as f:
             json.dump(manifest, f, indent=1)
-        with open(os.path.join(tmp, PARAMS), "wb") as f:
-            f.write(serialization.msgpack_serialize(_to_host(params)))
-        if opt_state is not None:
-            with open(os.path.join(tmp, OPT_STATE), "wb") as f:
-                f.write(serialization.to_bytes(_to_host(opt_state)))
-        if ema is not None:
-            with open(os.path.join(tmp, EMA), "wb") as f:
-                f.write(serialization.msgpack_serialize(_to_host(ema)))
         # swap in with no window where neither old nor new exists: move the
         # old checkpoint aside, rename the new one in, then delete the old
         old = None
@@ -190,6 +205,65 @@ def restore_train(path: str, optimizer) -> Tuple[Any, Any, dict]:
 
 
 # ---------------------------------------------------------------------------
+# validation — what "a checkpoint resume may trust" means
+# ---------------------------------------------------------------------------
+
+def validate(path: str) -> Tuple[bool, str]:
+    """(ok, reason) — is ``path`` a checkpoint a resume may trust?
+
+    A kill can only corrupt a checkpoint OUTSIDE the atomic-rename protocol
+    (partial scp, disk-full truncation, a writer bypassing ``save``), but
+    those cases are exactly the ones auto-resume must survive: a truncated
+    ``params.msgpack`` or missing manifest falls through to the previous
+    valid checkpoint instead of crashing the restarted run. Checks, in
+    order: manifest present + parseable JSON dict, then each payload's
+    size + crc32 against the manifest's ``payloads`` record (written by
+    ``save`` — integrity without msgpack-decoding multi-GB tensors into
+    host memory a second time). Pre-``payloads`` checkpoints fall back to
+    full msgpack decode of every payload present."""
+    try:
+        manifest = load_manifest(path)
+    except FileNotFoundError:
+        return False, "missing manifest"
+    except (ValueError, OSError) as e:
+        return False, f"unreadable manifest: {e}"
+    if not isinstance(manifest, dict):
+        return False, "manifest is not an object"
+    params_file = os.path.join(path, PARAMS)
+    if not os.path.exists(params_file):
+        return False, "missing params.msgpack"
+    payloads = manifest.get("payloads")
+    if isinstance(payloads, dict) and PARAMS in payloads:
+        for fname, info in payloads.items():
+            fpath = os.path.join(path, fname)
+            if not os.path.exists(fpath):
+                return False, f"missing {fname}"
+            if os.path.getsize(fpath) != info.get("bytes"):
+                return False, (f"corrupt {fname}: size "
+                               f"{os.path.getsize(fpath)} != recorded "
+                               f"{info.get('bytes')}")
+            crc = 0
+            with open(fpath, "rb") as f:
+                # chunked: a multi-GB payload must not materialize as one
+                # bytes object on the memory-pressured restart path
+                while chunk := f.read(1 << 22):
+                    crc = zlib.crc32(chunk, crc)
+            if crc != info.get("crc32"):
+                return False, f"corrupt {fname}: crc32 mismatch"
+        return True, "ok"
+    for fname in (PARAMS, OPT_STATE, EMA):
+        fpath = os.path.join(path, fname)
+        if not os.path.exists(fpath):
+            continue
+        try:
+            with open(fpath, "rb") as f:
+                serialization.msgpack_restore(f.read())
+        except Exception as e:
+            return False, f"corrupt {fname}: {type(e).__name__}: {e}"
+    return True, "ok"
+
+
+# ---------------------------------------------------------------------------
 # epoch-templated naming — the cross-CLI contract
 # ---------------------------------------------------------------------------
 
@@ -216,6 +290,84 @@ def latest(models_dir: str, name: str) -> Optional[Tuple[str, int]]:
             if best is None or epoch > best[1]:
                 best = (full, epoch)
     return best
+
+
+def latest_valid(models_dir: str, name: str):
+    """Newest (path, epoch) for ``name`` that passes ``validate`` — the
+    resume entry point when the newest checkpoint may be damaged (partial
+    copy, truncation). Invalid candidates are skipped newest-first with a
+    warning, falling back to the previous valid epoch; None when nothing
+    valid exists."""
+    if not os.path.isdir(models_dir):
+        return None
+    pat = re.compile(re.escape(name) + r"-(\d+)$")
+    candidates = []
+    for entry in os.listdir(models_dir):
+        m = pat.match(entry)
+        full = os.path.join(models_dir, entry)
+        if m and os.path.isdir(full):
+            candidates.append((int(m.group(1)), full))
+    for epoch, full in sorted(candidates, reverse=True):
+        ok, reason = validate(full)
+        if ok:
+            return full, epoch
+        print(f"warning: skipping invalid checkpoint {full!r} ({reason})",
+              flush=True)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# step-templated naming — mid-epoch supervisor checkpoints
+# ---------------------------------------------------------------------------
+# ``{name}-step{N}`` (N = completed optimizer steps) cannot collide with the
+# epoch template's ``{name}-{digits}`` and stays invisible to ``latest``, so
+# the cross-CLI contract (gen/mix read epoch checkpoints) is untouched; only
+# the resilience auto-resume path reads these.
+
+def step_ckpt_path(models_dir: str, name: str, step: int) -> str:
+    return os.path.join(models_dir, f"{name}-step{step}")
+
+
+def step_checkpoints(models_dir: str, name: str):
+    """All (step, path) step checkpoints for ``name``, oldest first."""
+    if not os.path.isdir(models_dir):
+        return []
+    pat = re.compile(re.escape(name) + r"-step(\d+)$")
+    out = []
+    for entry in os.listdir(models_dir):
+        m = pat.match(entry)
+        full = os.path.join(models_dir, entry)
+        if m and os.path.isdir(full) and \
+                os.path.exists(os.path.join(full, MANIFEST)):
+            out.append((int(m.group(1)), full))
+    return sorted(out)
+
+
+def latest_valid_step(models_dir: str, name: str):
+    """Newest (path, step) step checkpoint passing ``validate``, or None."""
+    for step, full in reversed(step_checkpoints(models_dir, name)):
+        ok, reason = validate(full)
+        if ok:
+            return full, step
+        print(f"warning: skipping invalid checkpoint {full!r} ({reason})",
+              flush=True)
+    return None
+
+
+def gc_steps(models_dir: str, name: str, keep: int) -> list:
+    """Delete all but the newest ``keep`` step checkpoints (epoch
+    checkpoints are never touched — they are the cross-CLI contract).
+    Returns the removed paths. Multi-host: primary only, mirroring
+    ``save``'s single-writer rule."""
+    from dalle_pytorch_tpu.parallel.multihost import is_primary
+    if not is_primary() or keep < 1:
+        return []
+    removed = []
+    ckpts = step_checkpoints(models_dir, name)
+    for _, full in ckpts[:max(len(ckpts) - keep, 0)]:
+        shutil.rmtree(full, ignore_errors=True)
+        removed.append(full)
+    return removed
 
 
 # ---------------------------------------------------------------------------

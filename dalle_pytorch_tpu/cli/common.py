@@ -51,6 +51,94 @@ def resolve_resume(name_or_path: str, models_dir: str, start_epoch: int):
     return path, epoch + 1
 
 
+def plan_resume(args, name: str, explicit: str = "",
+                steps_per_epoch: int = 0):
+    """Where should this run continue from? Returns None (fresh start) or
+    ``{path, start_epoch, skip_batches, global_step, meta, mid_epoch}``.
+
+    ``--auto_resume`` wins: the newest VALID checkpoint (step or epoch,
+    ordered by training progress — resilience.find_auto_resume). The data
+    stream continues mid-epoch with zero duplicated or skipped steps;
+    ``--n_epochs`` keeps the repo-wide meaning of "epochs to run from the
+    resume point" (the resumed partial epoch counts as the first), so a
+    restart passes the REMAINING epoch count — see the --auto_resume help
+    text and docs/RESILIENCE.md. Otherwise an ``explicit``
+    --loadVAE/--load_dalle/--load_clip value resolves through
+    ``resolve_resume`` as before. ``global_step`` falls back to
+    ``start_epoch * steps_per_epoch`` for checkpoints written before the
+    meta carried it."""
+    if args.auto_resume:
+        from dalle_pytorch_tpu.resilience import find_auto_resume
+        found = find_auto_resume(args.models_dir, name)
+        if found is not None:
+            path, manifest = found
+            meta = manifest.get("meta", {}) or {}
+            if "step_in_epoch" in meta and "epoch" in meta:
+                # skip_batches counts SOURCE records (bad skipped records
+                # included — checkpoint meta records_in_epoch, from the
+                # prefetcher's source_pos), while step_in_epoch counts
+                # TRAINED steps; with --max_bad_records the two diverge
+                # and conflating them would replay or drop batches
+                return {"path": path, "start_epoch": int(meta["epoch"]),
+                        "skip_batches": int(meta.get(
+                            "records_in_epoch", meta["step_in_epoch"])),
+                        "step_in_epoch": int(meta["step_in_epoch"]),
+                        "global_step": int(meta["global_step"]),
+                        "meta": meta, "mid_epoch": True}
+            epoch = int(meta.get("epoch", manifest.get("step", 0)))
+            gs = meta.get("global_step")
+            return {"path": path, "start_epoch": epoch + 1,
+                    "skip_batches": 0, "step_in_epoch": 0,
+                    "global_step": (int(gs) if gs is not None
+                                    else (epoch + 1) * steps_per_epoch),
+                    "meta": meta, "mid_epoch": False}
+    if explicit:
+        path, start_epoch = resolve_resume(explicit, args.models_dir,
+                                           args.start_epoch)
+        return {"path": path, "start_epoch": start_epoch,
+                "skip_batches": 0, "step_in_epoch": 0,
+                "global_step": start_epoch * steps_per_epoch,
+                "meta": {}, "mid_epoch": False}
+    return None
+
+
+def make_supervisor(args, metrics, name: str, save_state):
+    """The fault-tolerance supervisor for a training CLI, signal handlers
+    installed (docs/RESILIENCE.md). ``save_state(path) -> path`` is the
+    CLI's full-train-state writer closure."""
+    from dalle_pytorch_tpu.resilience import TrainSupervisor
+    return TrainSupervisor(
+        name=name, models_dir=args.models_dir, save_state=save_state,
+        metrics=metrics, save_every=args.save_every,
+        keep=args.keep_checkpoints, spike_factor=args.spike_factor,
+        spike_window=args.spike_window, max_rollbacks=args.max_rollbacks,
+        rewarm_steps=args.rewarm_steps).install_signal_handlers()
+
+
+def restore_rollback(sup, optimizer, mesh, param_specs=None):
+    """Restore (params, opt_state, ema) from the supervisor's newest valid
+    anchor after a NaN/loss-spike verdict. The train step donated the
+    now-poisoned buffers, so everything re-enters through the same
+    restore + setup_sharded path as a cold resume — including the SAME
+    ``param_specs`` the run was set up with (a --pp run re-placed without
+    its stage sharding would replicate the full stack on every device);
+    the EMA follows the params' placement leaf-by-leaf (make_ema's
+    rule)."""
+    from dalle_pytorch_tpu.parallel.train import setup_sharded
+    path = sup.rollback_target()
+    params, opt_state, _ = ckpt.restore_train(path, optimizer)
+    params, opt_state = setup_sharded(params, optimizer, mesh,
+                                      param_specs=param_specs,
+                                      opt_state=opt_state)
+    ema = ckpt.restore_ema(path)
+    if ema is not None:
+        import jax
+        ema = jax.tree.map(
+            lambda e, p: jax.device_put(e, getattr(p, "sharding", None)),
+            ema, params)
+    return params, opt_state, ema
+
+
 def add_common_args(parser: argparse.ArgumentParser,
                     default_batch: int = 24) -> None:
     parser.add_argument("--batchSize", type=int, default=default_batch,
@@ -114,6 +202,53 @@ def add_common_args(parser: argparse.ArgumentParser,
                              "keeps. Changes the optimizer-state shape: "
                              "pass the same value when resuming a "
                              "checkpoint")
+    # -- fault-tolerance runtime (docs/RESILIENCE.md) ----------------------
+    parser.add_argument("--auto_resume", action="store_true",
+                        help="resume from the newest VALID checkpoint "
+                             "(mid-epoch step checkpoints included) before "
+                             "falling back to a fresh start; the stream "
+                             "continues with zero duplicated or skipped "
+                             "steps. --n_epochs still means 'epochs to run "
+                             "from the resume point' (the repo-wide resume "
+                             "semantic), so pass the REMAINING count — and "
+                             "cosine users should pin --decay_steps, since "
+                             "the default horizon is recomputed from the "
+                             "resume epoch")
+    parser.add_argument("--save_every", type=int, default=0,
+                        help="write a mid-epoch checkpoint every N steps "
+                             "(0 = per-epoch only); these are the anchors "
+                             "preemption resume and loss-spike rollback "
+                             "restore from")
+    parser.add_argument("--keep_checkpoints", type=int, default=3,
+                        help="retain this many step checkpoints (older "
+                             "ones are GC'd; per-epoch checkpoints are "
+                             "never touched)")
+    parser.add_argument("--spike_factor", type=float, default=0.0,
+                        help="roll back to the last good checkpoint when "
+                             "the loss exceeds this multiple of the "
+                             "recent-window median (0 = NaN/Inf detection "
+                             "only)")
+    parser.add_argument("--spike_window", type=int, default=16,
+                        help="running-median window for --spike_factor")
+    parser.add_argument("--max_rollbacks", type=int, default=2,
+                        help="abort (TrainingDiverged) after this many "
+                             "loss-spike/NaN rollbacks — repeated spikes "
+                             "are divergence, not glitches")
+    parser.add_argument("--rewarm_steps", type=int, default=0,
+                        help="after a rollback, ramp the LR back up "
+                             "linearly over this many steps (0 = resume "
+                             "at full LR)")
+    parser.add_argument("--max_bad_records", type=int, default=0,
+                        help="skip up to this many unreadable/corrupt data "
+                             "records per epoch (counted + logged) before "
+                             "failing the run")
+    parser.add_argument("--init_deadline_s", type=float, default=0.0,
+                        help="bound multi-host backend bring-up to this "
+                             "many seconds per attempt, with backoff+"
+                             "jitter retries (0 = unbounded legacy join)")
+    parser.add_argument("--init_retries", type=int, default=3,
+                        help="bring-up attempts under --init_deadline_s "
+                             "before surfacing a structured failure")
 
 
 def make_optimizer(args, steps_per_epoch: int = 0, start_epoch: int = 0):
@@ -241,11 +376,25 @@ def setup_run(args, unit_name: str = "tokens"):
     """-> (mesh, MetricsLogger, StepProfiler). Applies NaN toggles/seeding.
 
     Joins the multi-host cluster first when configured (flags or env —
-    parallel.multihost), so the mesh below spans every host's devices."""
+    parallel.multihost), so the mesh below spans every host's devices.
+    With --init_deadline_s the join is deadline-bounded and retried with
+    backoff+jitter; exhausted retries exit with the structured bring-up
+    failure record instead of hanging (resilience.retry)."""
     from dalle_pytorch_tpu.parallel.multihost import initialize
-    initialize(coordinator_address=args.coordinator or None,
-               num_processes=args.num_processes or None,
-               process_id=args.process_id if args.process_id >= 0 else None)
+    from dalle_pytorch_tpu.resilience import BringupError, faults
+    faults.maybe_activate_from_env()
+    try:
+        initialize(coordinator_address=args.coordinator or None,
+                   num_processes=args.num_processes or None,
+                   process_id=args.process_id if args.process_id >= 0
+                   else None,
+                   deadline_s=args.init_deadline_s or None,
+                   max_attempts=args.init_retries,
+                   on_event=lambda rec: say(f"[resilience] {rec}"))
+    except BringupError as e:
+        import json as _json
+        raise SystemExit(
+            "backend bring-up failed: " + _json.dumps(e.record)) from e
     if args.nan_checks:
         enable_nan_checks(True)
     np.random.seed(args.seed)

@@ -17,6 +17,7 @@ Run: python -m dalle_pytorch_tpu.cli.train_clip --dataPath ./imagedata
 from __future__ import annotations
 
 import argparse
+import itertools
 import os
 
 import jax
@@ -26,8 +27,10 @@ import numpy as np
 from dalle_pytorch_tpu import checkpoint as ckpt
 from dalle_pytorch_tpu.cli.common import (add_common_args,
                                           load_caption_dataset, make_ema,
-                                          make_optimizer, resolve_resume,
+                                          make_optimizer, make_supervisor,
+                                          plan_resume, restore_rollback,
                                           say, setup_run)
+from dalle_pytorch_tpu.resilience import Preempted
 from dalle_pytorch_tpu.data import load_image_batch, prefetch
 from dalle_pytorch_tpu.models import clip as C
 from dalle_pytorch_tpu.parallel import make_train_step, shard_batch
@@ -87,13 +90,13 @@ def main(argv=None):
 
     key = jax.random.PRNGKey(args.seed)
 
-    start_epoch = args.start_epoch
-    resume_path = None
-    if args.load_clip:
-        # resolve the resume epoch BEFORE building the optimizer: the
-        # cosine horizon must cover already-completed epochs too
-        resume_path, start_epoch = resolve_resume(
-            args.load_clip, args.models_dir, start_epoch)
+    # resolve the resume point BEFORE building the optimizer: the cosine
+    # horizon must cover already-completed epochs too. --auto_resume picks
+    # the newest VALID checkpoint (mid-epoch step checkpoints included).
+    plan = plan_resume(args, args.name, explicit=args.load_clip,
+                       steps_per_epoch=len(dataset))
+    start_epoch = plan["start_epoch"] if plan else args.start_epoch
+    resume_path = plan["path"] if plan else None
     optimizer = make_optimizer(args, steps_per_epoch=len(dataset),
                                start_epoch=start_epoch)
     opt_state = None
@@ -102,6 +105,12 @@ def main(argv=None):
                                                          optimizer)
         cfg = C.CLIPConfig(**manifest["config"])
         say(f"resumed CLIP from {resume_path}")
+        if plan["mid_epoch"]:
+            metrics.resilience("resume", checkpoint=resume_path,
+                               epoch=start_epoch,
+                               step_in_epoch=plan["step_in_epoch"],
+                               records_in_epoch=plan["skip_batches"],
+                               global_step=plan["global_step"])
     else:
         params = C.clip_init(key, cfg, dtype=jnp.dtype(args.param_dtype))
 
@@ -117,38 +126,98 @@ def main(argv=None):
         return {"text": toks, "images": images,
                 "mask": np.asarray(toks) != 0}          # PAD = 0
 
-    global_step = 0
-    for epoch in range(start_epoch, start_epoch + args.n_epochs):
-        train_loss, n_batches = 0.0, 0
-        for hosted in prefetch(dataset.epoch(epoch), depth=2,
-                               transform=load_batch):
-            batch = shard_batch(mesh, hosted)
-            profiler.maybe_start(global_step)
-            params, opt_state, loss = step(
-                params, opt_state, batch,
-                jax.random.fold_in(key, global_step))
-            if ema is not None:
-                ema = ema_update(ema, params)
-            profiler.maybe_stop(global_step)
-            metrics.step(global_step, loss, epoch=epoch,
-                         units=args.batchSize, unit_name="pairs")
-            train_loss += float(loss)
-            n_batches += 1
-            global_step += 1
-        if n_batches == 0:
-            raise RuntimeError("empty dataset epoch")
+    # mutable loop state the supervisor's save_state closure reads live
+    global_step = plan["global_step"] if plan else 0
+    epoch = start_epoch
+    epoch_i = 0                       # batches completed in current epoch
+    train_loss, n_batches = 0.0, 0
 
-        avg = train_loss / n_batches
-        say(f"====> Epoch: {epoch} Average loss: {avg:.4f}")
-        path = ckpt.save(
-            ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
-            step=epoch, config=cfg, opt_state=opt_state, kind="clip",
-            meta={"epoch": epoch, "avg_loss": avg,
+    def save_state(path):
+        return ckpt.save(
+            path, params, step=global_step, config=cfg,
+            opt_state=opt_state, kind="clip",
+            meta={"epoch": epoch, "step_in_epoch": epoch_i,
+                  "global_step": global_step,
+                  "records_in_epoch": rec_base + (
+                      pf.source_pos if pf is not None else 0),
+                  "train_loss": train_loss,
+                  "n_batches": n_batches,
                   **({"ema_decay": args.ema_decay} if ema is not None
                      else {})}, ema=ema)
-        metrics.event(event="checkpoint", path=path, epoch=epoch,
-                      avg_loss=avg)
-    profiler.close()
+
+    sup = make_supervisor(args, metrics, args.name, save_state)
+    if resume_path:
+        # the checkpoint we just restored from is a valid rollback
+        # anchor — without it a NaN before the first cadence/epoch
+        # save after resume would raise instead of rolling back
+        sup.register_checkpoint(resume_path)
+    skip0 = plan["skip_batches"] if plan else 0
+    mid_meta = plan["meta"] if (plan and plan["mid_epoch"]) else {}
+    try:
+        for epoch in range(start_epoch, start_epoch + args.n_epochs):
+            skip = skip0 if epoch == start_epoch else 0
+            train_loss = float(mid_meta.get("train_loss", 0.0)) if skip \
+                else 0.0
+            n_batches = int(mid_meta.get("n_batches", 0)) if skip else 0
+            # epoch_i counts TRAINED steps; skip counts SOURCE records
+            epoch_i = int(mid_meta.get("step_in_epoch", skip)) \
+                if skip else 0
+            rec_base, pf = skip, None
+            it = dataset.epoch(epoch)
+            if skip:
+                it = itertools.islice(it, skip, None)
+            pf = prefetch(it, depth=2, transform=load_batch,
+                          max_bad_records=args.max_bad_records,
+                          on_event=lambda r: metrics.event(**r))
+            for hosted in pf:
+                batch = shard_batch(mesh, hosted)
+                batch = sup.pre_step(global_step, batch)
+                profiler.maybe_start(global_step)
+                params, opt_state, loss = step(
+                    params, opt_state, batch,
+                    jax.random.fold_in(key, global_step))
+                if ema is not None:
+                    ema = ema_update(ema, params)
+                profiler.maybe_stop(global_step)
+                lv = float(loss)
+                if sup.check_step(global_step, lv) == sup.ROLLBACK:
+                    params, opt_state, ema = restore_rollback(
+                        sup, optimizer, mesh)
+                    global_step += 1
+                    epoch_i += 1
+                    continue
+                metrics.step(global_step, lv, epoch=epoch,
+                             units=args.batchSize, unit_name="pairs")
+                train_loss += lv
+                n_batches += 1
+                global_step += 1
+                epoch_i += 1
+                sup.end_step(global_step)
+            if n_batches == 0:
+                raise RuntimeError("empty dataset epoch")
+
+            avg = train_loss / n_batches
+            say(f"====> Epoch: {epoch} Average loss: {avg:.4f}")
+            epoch_i = 0        # epoch complete: saved meta must say so
+            path = ckpt.save(
+                ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
+                step=epoch, config=cfg, opt_state=opt_state, kind="clip",
+                meta={"epoch": epoch, "avg_loss": avg,
+                      "global_step": global_step,
+                      **({"ema_decay": args.ema_decay} if ema is not None
+                         else {})}, ema=ema)
+            sup.register_checkpoint(path)
+            metrics.event(event="checkpoint", path=path, epoch=epoch,
+                          avg_loss=avg)
+            mid_meta = {}
+            skip0 = 0
+    except Preempted as p:
+        say(f"preempted — state saved to {p.path}; restart with "
+            "--auto_resume to continue")
+        return
+    finally:
+        sup.close()
+        profiler.close()
 
 
 if __name__ == "__main__":

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import functools
+import itertools
 import os
 
 import jax
@@ -33,7 +34,9 @@ import optax
 
 from dalle_pytorch_tpu import checkpoint as ckpt
 from dalle_pytorch_tpu.cli.common import (add_common_args, make_optimizer,
-                                          resolve_resume, say, setup_run)
+                                          make_supervisor, plan_resume,
+                                          restore_rollback, say, setup_run)
+from dalle_pytorch_tpu.resilience import Preempted
 from dalle_pytorch_tpu.data import ImageFolderDataset, prefetch, \
     save_image_grid, shard_for_host
 from dalle_pytorch_tpu.models import vae as V
@@ -93,6 +96,10 @@ def make_step(cfg: V.VAEConfig, optimizer, clip: float,
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch, rng):
+        batch = dict(batch)
+        # optional traced update scale (resilience LR re-warm) — for Adam
+        # exactly an LR multiplier, like parallel.train.make_train_step
+        lr_scale = batch.pop("lr_scale", None)
         if grad_accum > 1:
             from dalle_pytorch_tpu.parallel.train import accumulate_grads
             loss, grads = accumulate_grads(loss_fn, params, batch, rng,
@@ -100,6 +107,9 @@ def make_step(cfg: V.VAEConfig, optimizer, clip: float,
         else:
             loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if lr_scale is not None:
+            updates = jax.tree.map(
+                lambda u: (u * lr_scale).astype(u.dtype), updates)
         params = optax.apply_updates(params, updates)
         if clip > 0:
             params = jax.tree.map(lambda p: jnp.clip(p, -clip, clip), params)
@@ -128,13 +138,13 @@ def main(argv=None):
     key = jax.random.PRNGKey(args.seed)
 
     temperature = args.temperature
-    start_epoch = args.start_epoch
-    resume_path = None
-    if args.loadVAE:
-        # resolve the resume epoch BEFORE building the optimizer: the
-        # cosine horizon must cover already-completed epochs too
-        resume_path, start_epoch = resolve_resume(
-            args.loadVAE, args.models_dir, start_epoch)
+    # resolve the resume point BEFORE building the optimizer: the cosine
+    # horizon must cover already-completed epochs too. --auto_resume picks
+    # the newest VALID checkpoint (mid-epoch step checkpoints included).
+    plan = plan_resume(args, args.name, explicit=args.loadVAE,
+                       steps_per_epoch=len(dataset))
+    start_epoch = plan["start_epoch"] if plan else args.start_epoch
+    resume_path = plan["path"] if plan else None
     optimizer = make_optimizer(args, steps_per_epoch=len(dataset),
                                start_epoch=start_epoch)
     opt_state = None
@@ -144,6 +154,12 @@ def main(argv=None):
         cfg = ckpt.vae_config_from_manifest(manifest)
         temperature = manifest["meta"].get("temperature", temperature)
         say(f"resumed VAE from {resume_path}")
+        if plan["mid_epoch"]:
+            metrics.resilience("resume", checkpoint=resume_path,
+                               epoch=start_epoch,
+                               step_in_epoch=plan["step_in_epoch"],
+                               records_in_epoch=plan["skip_batches"],
+                               global_step=plan["global_step"])
     else:
         params = V.vae_init(key, cfg, dtype=jnp.dtype(args.param_dtype))
 
@@ -167,61 +183,130 @@ def main(argv=None):
         decoded = V.decode(params, V.get_codebook_indices(params, images))
         return recon, decoded
 
-    global_step = 0
-    for epoch in range(start_epoch, start_epoch + args.n_epochs):
-        train_loss, n_batches = 0.0, 0
-        last_batch = None
-        for images in prefetch(dataset.epoch(epoch), depth=2):
-            batch = shard_batch(mesh, {"images": images})
-            batch["temperature"] = jnp.float32(temperature)
-            profiler.maybe_start(global_step)
-            params, opt_state, loss = step(
-                params, opt_state, batch,
-                jax.random.fold_in(key, global_step))
-            if ema is not None:
-                ema = ema_update(ema, params)
-            profiler.maybe_stop(global_step)
-            metrics.step(global_step, loss, epoch=epoch,
-                         units=images.shape[0], unit_name="images")
-            train_loss += float(loss)
-            n_batches += 1
-            global_step += 1
-            last_batch = batch
-        if n_batches == 0:
-            raise RuntimeError("empty dataset epoch")
+    # mutable loop state the supervisor's save_state closure reads live
+    global_step = plan["global_step"] if plan else 0
+    epoch = start_epoch
+    epoch_i = 0                       # batches completed in current epoch
+    train_loss, n_batches = 0.0, 0
 
-        if args.tempsched:
-            temperature *= dk
-            say("Current temperature: ", temperature)
-
-        # per-epoch recon grid (input | recon | argmax decode), first 8.
-        # fetch_local: the batch is dp-sharded across (possibly) hosts —
-        # allgather the k rows so every process feeds the jit identical
-        # data (SPMD) and np.asarray never touches non-addressable shards
-        from dalle_pytorch_tpu.parallel.multihost import fetch_local
-        k = min(8, args.batchSize)
-        imgs = jnp.asarray(fetch_local(last_batch["images"])[:k])
-        recons, decoded = eval_fn(params, imgs,
-                                  jax.random.fold_in(key, epoch),
-                                  jnp.float32(temperature))
-        grid = np.concatenate([np.asarray(imgs), np.asarray(recons),
-                               np.asarray(decoded)])
-        grid_path = os.path.join(args.results_dir,
-                                 f"{args.name}_epoch_{epoch}.png")
-        save_image_grid(grid, grid_path, nrow=k)
-
-        avg = train_loss / n_batches
-        say(f"====> Epoch: {epoch} Average loss: {avg:.8f}")
-        path = ckpt.save(
-            ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
-            step=epoch, config=cfg, opt_state=opt_state, kind="vae",
+    def save_state(path):
+        """Full mid-epoch train state — resume needs params, opt state,
+        EMA, schedule meta AND the loop position (global_step/epoch/
+        step_in_epoch + accumulators for the epoch summary)."""
+        return ckpt.save(
+            path, params, step=global_step, config=cfg,
+            opt_state=opt_state, kind="vae",
             meta={"temperature": temperature, "epoch": epoch,
-                  "avg_loss": avg,
+                  "step_in_epoch": epoch_i, "global_step": global_step,
+                  "records_in_epoch": rec_base + (
+                      pf.source_pos if pf is not None else 0),
+                  "train_loss": train_loss, "n_batches": n_batches,
                   **({"ema_decay": args.ema_decay} if ema is not None
                      else {})}, ema=ema)
-        metrics.event(event="checkpoint", path=path, epoch=epoch,
-                      avg_loss=avg, temperature=temperature)
-    profiler.close()
+
+    sup = make_supervisor(args, metrics, args.name, save_state)
+    if resume_path:
+        # the checkpoint we just restored from is a valid rollback
+        # anchor — without it a NaN before the first cadence/epoch
+        # save after resume would raise instead of rolling back
+        sup.register_checkpoint(resume_path)
+    skip0 = plan["skip_batches"] if plan else 0
+    mid_meta = plan["meta"] if (plan and plan["mid_epoch"]) else {}
+    try:
+        for epoch in range(start_epoch, start_epoch + args.n_epochs):
+            skip = skip0 if epoch == start_epoch else 0
+            # a mid-epoch resume restores the interrupted epoch's summary
+            # accumulators so avg_loss covers every step exactly once
+            train_loss = float(mid_meta.get("train_loss", 0.0)) if skip \
+                else 0.0
+            n_batches = int(mid_meta.get("n_batches", 0)) if skip else 0
+            # epoch_i counts TRAINED steps; skip counts SOURCE records
+            epoch_i = int(mid_meta.get("step_in_epoch", skip)) \
+                if skip else 0
+            rec_base, pf = skip, None
+            last_batch = None
+            it = dataset.epoch(epoch)
+            if skip:
+                # deterministic per-epoch order (seeded stateless shuffle):
+                # skipping the completed prefix replays nothing
+                it = itertools.islice(it, skip, None)
+            pf = prefetch(it, depth=2,
+                          max_bad_records=args.max_bad_records,
+                          on_event=lambda r: metrics.event(**r))
+            for images in pf:
+                batch = shard_batch(mesh, {"images": images})
+                batch["temperature"] = jnp.float32(temperature)
+                batch = sup.pre_step(global_step, batch)
+                profiler.maybe_start(global_step)
+                params, opt_state, loss = step(
+                    params, opt_state, batch,
+                    jax.random.fold_in(key, global_step))
+                if ema is not None:
+                    ema = ema_update(ema, params)
+                profiler.maybe_stop(global_step)
+                lv = float(loss)
+                if sup.check_step(global_step, lv) == sup.ROLLBACK:
+                    params, opt_state, ema = restore_rollback(
+                        sup, optimizer, mesh)
+                    global_step += 1
+                    epoch_i += 1
+                    continue
+                metrics.step(global_step, lv, epoch=epoch,
+                             units=images.shape[0], unit_name="images")
+                train_loss += lv
+                n_batches += 1
+                global_step += 1
+                epoch_i += 1
+                last_batch = batch
+                sup.end_step(global_step)
+            if n_batches == 0:
+                raise RuntimeError("empty dataset epoch")
+
+            if args.tempsched:
+                temperature *= dk
+                say("Current temperature: ", temperature)
+
+            # per-epoch recon grid (input | recon | argmax decode), first 8.
+            # fetch_local: the batch is dp-sharded across (possibly) hosts —
+            # allgather the k rows so every process feeds the jit identical
+            # data (SPMD) and np.asarray never touches non-addressable
+            # shards. A resume that landed exactly on the epoch boundary has
+            # no batch in hand — skip the grid, keep the checkpoint.
+            if last_batch is not None:
+                from dalle_pytorch_tpu.parallel.multihost import fetch_local
+                k = min(8, args.batchSize)
+                imgs = jnp.asarray(fetch_local(last_batch["images"])[:k])
+                recons, decoded = eval_fn(params, imgs,
+                                          jax.random.fold_in(key, epoch),
+                                          jnp.float32(temperature))
+                grid = np.concatenate([np.asarray(imgs), np.asarray(recons),
+                                       np.asarray(decoded)])
+                grid_path = os.path.join(args.results_dir,
+                                         f"{args.name}_epoch_{epoch}.png")
+                save_image_grid(grid, grid_path, nrow=k)
+
+            avg = train_loss / n_batches
+            say(f"====> Epoch: {epoch} Average loss: {avg:.8f}")
+            epoch_i = 0        # epoch complete: saved meta must say so
+            path = ckpt.save(
+                ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
+                step=epoch, config=cfg, opt_state=opt_state, kind="vae",
+                meta={"temperature": temperature, "epoch": epoch,
+                      "avg_loss": avg, "global_step": global_step,
+                      **({"ema_decay": args.ema_decay} if ema is not None
+                         else {})}, ema=ema)
+            sup.register_checkpoint(path)
+            metrics.event(event="checkpoint", path=path, epoch=epoch,
+                          avg_loss=avg, temperature=temperature)
+            mid_meta = {}
+            skip0 = 0
+    except Preempted as p:
+        say(f"preempted — state saved to {p.path}; restart with "
+            "--auto_resume to continue")
+        return
+    finally:
+        sup.close()
+        profiler.close()
 
 
 if __name__ == "__main__":

@@ -45,39 +45,116 @@ class Prefetcher:
     reads), so disk + decode overlap device compute. ``sharding`` device_puts
     each batch with a NamedSharding (global array for pjit); None leaves the
     put to jit's default device placement.
+
+    Data-path resilience (docs/RESILIENCE.md):
+      * a worker exception is re-raised on the CONSUMER side, after the
+        already-queued good batches drain — never swallowed;
+      * ``max_bad_records`` > 0 skips up to that many records whose
+        transform/device-put fails (one unreadable image must not kill an
+        11-hour run), counting them (``self.bad_records``) and reporting
+        each through ``on_event``; record N+1 propagates;
+      * ``iterator_retries`` > 0 retries ``next()`` on the SOURCE after an
+        exception, for iterators wrapping transient backends (a raised
+        GENERATOR is closed and yields StopIteration on retry, so the
+        default stays 0: propagate — silent truncation is worse than a
+        crash);
+      * a worker thread that dies without posting its sentinel (hard kill)
+        is detected by the consumer and restarted once from the shared
+        iterator.
     """
 
     _DONE = object()
 
     def __init__(self, it: Iterable, depth: int = 2,
                  transform: Optional[Callable[[Any], Any]] = None,
-                 sharding=None):
+                 sharding=None, max_bad_records: int = 0,
+                 iterator_retries: int = 0,
+                 on_event: Optional[Callable[[dict], None]] = None):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
         self._err: Optional[BaseException] = None
         self._transform = transform
         self._sharding = sharding
-        self._thread = threading.Thread(
-            target=self._worker, args=(iter(it),), daemon=True)
+        self._max_bad = max(int(max_bad_records), 0)
+        self._it_retries = max(int(iterator_retries), 0)
+        self._on_event = on_event
+        self._it = iter(it)
+        self.bad_records = 0
+        self.iterator_retries = 0
+        # source records consumed up to AND INCLUDING the last batch this
+        # consumer received (bad skipped records counted) — what a mid-epoch
+        # resume must skip to replay nothing: with max_bad_records > 0 the
+        # trained-step count alone undercounts the source position
+        self.source_pos = 0
+        # the worker's own running position — an attribute (not a worker
+        # local) so a restarted worker resumes counting where the dead one
+        # stopped instead of resetting and corrupting source_pos
+        self._worker_pos = 0
+        self._thread_restarts_left = 1
+        self._start_worker()
+
+    def _start_worker(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
-    def _worker(self, it: Iterator):
+    def _emit(self, kind: str, **fields) -> None:
+        if self._on_event is None:
+            return
+        from dalle_pytorch_tpu.utils.metrics import structured_event
         try:
-            for batch in it:
-                if self._transform is not None:
-                    batch = self._transform(batch)
-                # multi-host: keep batches on the HOST — shard_batch
-                # assembles the global array from each process's local data
-                # (a premature local device_put would just be pulled back)
-                if jax.process_count() > 1:
-                    pass
-                elif self._sharding is not None:
-                    batch = jax.tree.map(
-                        lambda x: jax.device_put(x, self._sharding), batch)
-                else:
-                    batch = jax.tree.map(jax.device_put, batch)
-                self._q.put(batch)
-        except BaseException as e:  # surfaced on the consumer side
-            self._err = e
+            self._on_event(structured_event(kind, **fields))
+        except Exception:
+            pass                  # an event sink must never kill the feed
+
+    def _worker(self):
+        it = self._it
+        pos = self._worker_pos        # source records consumed by the worker
+        try:
+            while True:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                except BaseException as e:
+                    if self.iterator_retries < self._it_retries:
+                        self.iterator_retries += 1
+                        self._emit("prefetch_iterator_retry",
+                                   error=f"{type(e).__name__}: {e}",
+                                   retry=self.iterator_retries)
+                        continue
+                    self._err = e
+                    return
+                pos += 1
+                self._worker_pos = pos
+                try:
+                    if self._transform is not None:
+                        batch = self._transform(batch)
+                    # multi-host: keep batches on the HOST — shard_batch
+                    # assembles the global array from each process's local
+                    # data (a premature local device_put would just be
+                    # pulled back)
+                    if jax.process_count() > 1:
+                        pass
+                    elif self._sharding is not None:
+                        batch = jax.tree.map(
+                            lambda x: jax.device_put(x, self._sharding),
+                            batch)
+                    else:
+                        batch = jax.tree.map(jax.device_put, batch)
+                except BaseException as e:
+                    if self.bad_records < self._max_bad:
+                        self.bad_records += 1
+                        self._emit("prefetch_bad_record",
+                                   error=f"{type(e).__name__}: {e}",
+                                   skipped=self.bad_records,
+                                   cap=self._max_bad)
+                        continue
+                    self._err = e
+                    return
+                # pair each batch with the worker's source position so the
+                # consumer's view (source_pos) never runs ahead of what it
+                # actually received — the worker may be several records
+                # (including skipped bad ones) past the queue head
+                self._q.put((pos, batch))
         finally:
             self._q.put(self._DONE)
 
@@ -85,17 +162,42 @@ class Prefetcher:
         return self
 
     def __next__(self):
-        item = self._q.get()
+        while True:
+            try:
+                item = self._q.get(timeout=0.5)
+                break
+            except queue.Empty:
+                if self._thread.is_alive():
+                    continue
+                # sentinel pending in the queue: loop once more to take it
+                if not self._q.empty():
+                    continue
+                # the worker died WITHOUT its finally-sentinel (hard kill,
+                # interpreter teardown race): restart it once from the
+                # shared iterator, then give up loudly — a silently dead
+                # feed would hang the train loop forever
+                if self._thread_restarts_left > 0:
+                    self._thread_restarts_left -= 1
+                    self._emit("prefetch_restart")
+                    self._start_worker()
+                    continue
+                raise RuntimeError(
+                    "prefetch worker died without reporting an error "
+                    "(restart already spent)")
         if item is self._DONE:
             if self._err is not None:
                 raise self._err
             raise StopIteration
-        return item
+        self.source_pos, batch = item
+        return batch
 
 
 def prefetch(it: Iterable, depth: int = 2,
              transform: Optional[Callable[[Any], Any]] = None,
-             sharding=None) -> Prefetcher:
+             sharding=None, max_bad_records: int = 0,
+             iterator_retries: int = 0,
+             on_event: Optional[Callable[[dict], None]] = None) -> Prefetcher:
     """Convenience wrapper: ``for batch in prefetch(dataset.epoch(e)): ...``"""
     return Prefetcher(it, depth=depth, transform=transform,
-                      sharding=sharding)
+                      sharding=sharding, max_bad_records=max_bad_records,
+                      iterator_retries=iterator_retries, on_event=on_event)

@@ -420,7 +420,12 @@ def generate_images(params: dict, vae_params: dict, text: Array, *,
     (ops.decode.init_cache) — halves the cache's share of per-token HBM
     reads (bench.decode_roofline_ms_per_token quantifies it; the term
     dominates at batch > 1). Composes with ``quantize_for_decode``
-    (int8 weights) for the full int8 decode path.
+    (int8 weights) for the full int8 decode path. Accuracy: the int8
+    rows plus the scale-cast-to-score-dtype under bf16 compound to a
+    ~1% relative attention-output error bound per layer (see
+    ops.decode.init_cache); tests/test_quant.py's 2% end-to-end parity
+    tolerance is that contract. There is no opt-out short of
+    ``quantize_cache=False``.
     """
     if clip_params is not None and \
             clip_cfg.num_text_tokens < cfg.num_text_tokens:

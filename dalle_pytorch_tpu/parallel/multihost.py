@@ -35,7 +35,10 @@ _initialized = False
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None,
-               local_device_ids: Optional[Sequence[int]] = None) -> bool:
+               local_device_ids: Optional[Sequence[int]] = None,
+               deadline_s: Optional[float] = None,
+               max_attempts: int = 3,
+               on_event=None) -> bool:
     """Join (or form) the multi-host cluster. Returns True iff distributed
     mode was initialized.
 
@@ -45,6 +48,23 @@ def initialize(coordinator_address: Optional[str] = None,
     launched on a pod, ``jax.distributed.initialize()`` with no arguments
     resolves from the metadata server). With neither arguments, env vars,
     nor a pod environment this is a single-process no-op returning False.
+
+    ``deadline_s`` bounds the cluster join (a wedged coordinator otherwise
+    pends it indefinitely — the round-5 failure mode): each of
+    ``max_attempts`` attempts runs under the deadline with jittered
+    exponential backoff between them (resilience.retry), retry records
+    flowing to ``on_event``; exhausted attempts raise
+    ``resilience.BringupError`` carrying the structured failure record
+    instead of hanging. None (default) keeps the legacy unbounded join.
+
+    Caveat: a deadline-cut attempt ABANDONS its daemon thread, which may
+    still be blocked inside ``jax.distributed.initialize``; a retry then
+    races it against a fresh call. That is acceptable for the wedge this
+    defends against (the abandoned call is stuck in connect and never
+    mutates the client), but a retried init that merely *straggles* can
+    interleave with its successor — bench avoids this by re-exec'ing a
+    fresh process per attempt (claim_backend), which is the right model
+    for anything beyond a launcher; see ROADMAP open items.
 
     Idempotent: a second call (same process) is a no-op returning True.
     """
@@ -67,17 +87,30 @@ def initialize(coordinator_address: Optional[str] = None,
         if len([h for h in hosts.split(",") if h.strip()]) <= 1:
             return False
 
-    try:
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=nproc,
-                                   process_id=pid,
-                                   local_device_ids=local_device_ids)
-    except RuntimeError as e:
-        # someone initialized jax.distributed without going through this
-        # module ("distributed.initialize should only be called once")
-        msg = str(e).lower()
-        if "already" not in msg and "only be called once" not in msg:
-            raise
+    def _join(attempt: int = 0):
+        from dalle_pytorch_tpu.resilience import faults
+        faults.on_backend_init(attempt)
+        try:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=nproc,
+                                       process_id=pid,
+                                       local_device_ids=local_device_ids)
+        except RuntimeError as e:
+            # someone initialized jax.distributed without going through
+            # this module ("distributed.initialize should only be called
+            # once")
+            msg = str(e).lower()
+            if "already" not in msg and "only be called once" not in msg:
+                raise
+
+    if deadline_s and deadline_s > 0:
+        from dalle_pytorch_tpu.resilience import retry as rretry
+        policy = rretry.RetryPolicy(max_attempts=max(max_attempts, 1),
+                                    deadline_s=deadline_s)
+        rretry.retry_with_backoff(_join, policy, label="multihost_init",
+                                  on_event=on_event)
+    else:
+        _join()
     _initialized = True
     return True
 

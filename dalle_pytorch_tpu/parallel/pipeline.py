@@ -36,7 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 # jax >= 0.8 required (pyproject pin): shard_map(axis_names=...) keeps
 # non-pipeline mesh axes (e.g. 'ep') as GSPMD auto axes
-from jax import shard_map
+from dalle_pytorch_tpu.parallel._compat import pcast_varying, shard_map
 
 Array = jax.Array
 
@@ -121,7 +121,9 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
 
     def stage_fn(stage_params, xm, maskm, rng):
         sp = jax.tree.map(lambda a: a[0], stage_params)   # local layer slice
-        P_ = lax.axis_size(axis)
+        # static stage count from the enclosing mesh (== the manual axis
+        # size; lax.axis_size is a jax>=0.8 addition — see parallel._compat)
+        P_ = num_stages
         idx = lax.axis_index(axis)
         ticks = M + P_ - 1
         # pad the input stream so ticks beyond M feed (ignored) zeros
@@ -157,10 +159,9 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
             # from the activations, while the dense stack's aux is a
             # literal 0.0 constant (non-varying) — match each case
             if cfg.moe_experts:
-                zero_aux = lax.pcast(
+                zero_aux = pcast_varying(
                     jnp.float32(0.0),
-                    tuple(a for a in (axis, dp_axis) if a is not None),
-                    to="varying")
+                    tuple(a for a in (axis, dp_axis) if a is not None))
             else:
                 zero_aux = jnp.float32(0.0)
             out, aux = lax.cond(active, run, lambda h: (h, zero_aux), h)
@@ -170,7 +171,7 @@ def pipeline_transformer(params, x: Array, *, cfg, mesh: Mesh,
 
         # the carry is device-varying over pp (each stage holds a different
         # microbatch's activations) — mark the zero init accordingly
-        state0 = lax.pcast(jnp.zeros_like(xm[0]), (axis,), to="varying")
+        state0 = pcast_varying(jnp.zeros_like(xm[0]), (axis,))
         _, (outs, auxs) = lax.scan(tick, state0,
                                    (jnp.arange(ticks), stream[:ticks],
                                     masks))
@@ -217,7 +218,16 @@ def pp_param_specs(params, axis: str = "pp", ep: Optional[str] = None):
     specs = {k: (jax.tree.map(lambda _: P(axis), v) if k == "transformer"
                  else jax.tree.map(lambda _: P(), v))
              for k, v in params.items()}
-    if ep is not None and "moe" in specs["transformer"].get("ff", {}):
+    if ep is not None:
+        if "moe" not in specs.get("transformer", {}).get("ff", {}):
+            # a layout drift must surface, not silently degrade to
+            # replicated experts (ADVICE r5 #3): the caller asked for
+            # expert parallelism and would quietly lose it
+            raise ValueError(
+                f"ep={ep!r} requested but the param tree has no "
+                "['transformer']['ff']['moe'] subtree — the model was "
+                "built without MoE (moe_experts=0) or the MoE param "
+                "layout moved; update pp_param_specs' path to match")
         moe = specs["transformer"]["ff"]["moe"]
         moe["w1"] = P(axis, ep)          # (depth, E, dim, hidden)
         moe["w2"] = P(axis, ep)

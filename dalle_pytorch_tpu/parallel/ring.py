@@ -36,7 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 # jax >= 0.8 required (pyproject pin) — same discipline as
 # parallel.sequence / parallel.pipeline
-from jax import shard_map
+from dalle_pytorch_tpu.parallel._compat import shard_map
 
 
 def _online_block(carry, kb, vb, q, scale, allow, pair_ok=None):

@@ -51,7 +51,7 @@ from dalle_pytorch_tpu.parallel.ring import (ring_attention_local,
 # jax >= 0.8 required: this module leans on shard_map(axis_names=...)
 # (partial-manual lowering) which the old experimental shard_map lacks —
 # a silent fallback would only defer the failure to every call site
-from jax import shard_map
+from dalle_pytorch_tpu.parallel._compat import shard_map
 
 
 def _check_cfg(cfg: T.TransformerConfig) -> None:

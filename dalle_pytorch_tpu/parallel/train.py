@@ -45,16 +45,26 @@ def make_train_step(loss_fn: Callable, optimizer,
     is 1/N. The batch must be a dict; scalar entries (e.g. a traced
     temperature) pass through unsplit, array entries' leading dim must
     divide.
+
+    An optional scalar ``batch['lr_scale']`` multiplies the optimizer
+    updates (for Adam, exactly an LR scale) — the resilience supervisor's
+    post-rollback re-warm rides it as a traced input, so the ramp never
+    recompiles. Absent key = scale 1.
     """
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch, rng):
+        batch = dict(batch)
+        lr_scale = batch.pop("lr_scale", None)
         if grad_accum <= 1:
             loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
         else:
             loss, grads = accumulate_grads(loss_fn, params, batch, rng,
                                            grad_accum)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if lr_scale is not None:
+            updates = jax.tree.map(
+                lambda u: (u * lr_scale).astype(u.dtype), updates)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 

@@ -1,0 +1,197 @@
+"""Deterministic fault injection — every failure mode the resilience
+runtime defends against, reproducible on CPU in tier-1 tests.
+
+A ``FaultPlan`` names the faults to fire; production code calls the narrow
+hook functions below, which are no-ops unless a plan is active (activated
+programmatically by tests, or via the ``DALLE_FAULTS`` env var — a JSON
+FaultPlan — for subprocess/CLI runs). Hooks fire AT MOST ONCE per
+activation: a preemption signal or a NaN batch is a point event, and
+firing it every matching step would make recovery untestable.
+
+Simulated faults (pytest -m faults exercises each):
+  * hung / failing backend init        -> on_backend_init
+  * mid-run SIGTERM (preemption)       -> maybe_signal
+  * NaN gradients (poisoned batch)     -> corrupt_batch
+  * crashing data iterator             -> crashing_iterator (test helper)
+  * truncated / corrupt checkpoints    -> truncate_params / remove_manifest
+                                          / simulate_interrupted_save
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import signal
+import time
+from typing import Iterator, Optional
+
+
+class FaultInjected(RuntimeError):
+    """Raised by hooks that simulate a hard failure."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    # backend bring-up: sleep (wedge) this long per init attempt, and/or
+    # raise on the first N attempts (0-indexed attempts < fail_attempts)
+    backend_init_hang_s: float = 0.0
+    backend_init_fail_attempts: int = 0
+    # training loop: deliver SIGTERM to this process just before this step
+    sigterm_at_step: int = -1
+    # training loop: replace the batch's float leaves with NaN at this step
+    nan_at_step: int = -1
+
+
+_active: Optional[FaultPlan] = None
+_fired: set = set()
+
+ENV = "DALLE_FAULTS"
+
+
+def activate(plan: FaultPlan) -> FaultPlan:
+    global _active
+    _active = plan
+    _fired.clear()
+    return plan
+
+
+def deactivate() -> None:
+    global _active
+    _active = None
+    _fired.clear()
+
+
+def active() -> Optional[FaultPlan]:
+    return _active
+
+
+def maybe_activate_from_env() -> Optional[FaultPlan]:
+    """Activate a plan from the ``DALLE_FAULTS`` JSON env var (subprocess /
+    CLI harness path). No-op when unset or a plan is already active."""
+    if _active is not None:
+        return _active
+    raw = os.environ.get(ENV, "")
+    if not raw:
+        return None
+    return activate(FaultPlan(**json.loads(raw)))
+
+
+@contextlib.contextmanager
+def injected(**kwargs):
+    """``with faults.injected(nan_at_step=3): ...`` — scoped activation."""
+    activate(FaultPlan(**kwargs))
+    try:
+        yield _active
+    finally:
+        deactivate()
+
+
+def _once(key: str) -> bool:
+    if key in _fired:
+        return False
+    _fired.add(key)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# hooks called from production code (all no-ops without an active plan)
+# ---------------------------------------------------------------------------
+
+def on_backend_init(attempt: int = 0) -> None:
+    """Inside the deadline-bounded bring-up fn: wedge and/or fail."""
+    p = _active
+    if p is None:
+        return
+    if p.backend_init_hang_s > 0:
+        time.sleep(p.backend_init_hang_s)
+    if attempt < p.backend_init_fail_attempts:
+        raise FaultInjected(
+            f"injected backend init failure (attempt {attempt})")
+
+
+def maybe_signal(step: int) -> None:
+    """Deliver SIGTERM to this process before step ``sigterm_at_step`` —
+    the supervisor's handler turns it into a preemption checkpoint."""
+    p = _active
+    if p is not None and step == p.sigterm_at_step and _once("sigterm"):
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def corrupt_batch(batch, step: int):
+    """NaN-poison every float leaf of ``batch`` at step ``nan_at_step`` —
+    the downstream loss/grads go NaN exactly once, deterministically.
+
+    A batch with NO float leaves (e.g. train_dalle's integer token ids)
+    cannot be poisoned this way — raise instead of silently consuming the
+    one-shot fire, so a fault test against such a CLI fails loudly rather
+    than passing vacuously (that path needs a loss-level hook)."""
+    p = _active
+    if p is None or step != p.nan_at_step or not _once("nan"):
+        return batch
+    import jax
+    import jax.numpy as jnp
+
+    poisoned = []
+
+    def poison(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            poisoned.append(True)
+            return jnp.full_like(x, jnp.nan)
+        return x
+
+    out = jax.tree.map(poison, batch)
+    if not poisoned:
+        raise FaultInjected(
+            f"nan_at_step={step} fired but the batch has no float leaves "
+            "to poison (integer token ids?) — this fault cannot simulate "
+            "a NaN loss on this training path")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# test-side helpers (no production hook needed)
+# ---------------------------------------------------------------------------
+
+def crashing_iterator(items, crash_at: int,
+                      exc: Optional[BaseException] = None) -> Iterator:
+    """Yield ``items`` until index ``crash_at``, then raise — the data-path
+    fault ``data.prefetch`` must propagate (or, with ``max_bad_records``
+    wrapping at the record level, skip)."""
+    for i, item in enumerate(items):
+        if i == crash_at:
+            raise exc if exc is not None else FaultInjected(
+                f"injected iterator crash at record {i}")
+        yield item
+
+
+def truncate_params(ckpt_dir: str, keep_bytes: int = 16) -> str:
+    """Truncate a checkpoint's params.msgpack — the partial-write corruption
+    ``checkpoint.validate`` must catch."""
+    from dalle_pytorch_tpu import checkpoint as ckpt
+    path = os.path.join(ckpt_dir, ckpt.PARAMS)
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(data[:keep_bytes])
+    return path
+
+
+def remove_manifest(ckpt_dir: str) -> str:
+    """Delete a checkpoint's manifest — e.g. a botched manual copy."""
+    from dalle_pytorch_tpu import checkpoint as ckpt
+    path = os.path.join(ckpt_dir, ckpt.MANIFEST)
+    os.remove(path)
+    return path
+
+
+def simulate_interrupted_save(models_dir: str) -> str:
+    """Leave a ``.ckpt-tmp-*`` staging dir behind, as if the writer died
+    between the tmp write and the atomic rename. Resume discovery must
+    ignore it (it never matches the name template) and GC must not trip."""
+    import tempfile
+    tmp = tempfile.mkdtemp(dir=models_dir, prefix=".ckpt-tmp-")
+    with open(os.path.join(tmp, "params.msgpack"), "wb") as f:
+        f.write(b"\x00" * 64)
+    return tmp

@@ -14,7 +14,21 @@ import os
 import time
 from typing import Optional
 
-import jax
+# NOTE: jax is imported lazily inside MetricsLogger — ``structured_event``
+# must be importable before any backend exists (resilience.retry emits
+# bring-up failure records from bench.py's pre-claim main thread, where a
+# jax import must stay inside the deadline-bounded claim thread).
+
+
+def structured_event(kind: str, **fields) -> dict:
+    """The canonical resilience-event record: every failure/retry/rollback/
+    preempt/resume event in the system is one of these, so benches and
+    VERDICT can distinguish "stale because wedged" from "retried and
+    recovered" by grepping one shape. ``kind`` ∈ {bringup_retry,
+    bringup_failure, rollback, diverged, step_checkpoint, preempt_signal,
+    preempted, resume, prefetch_bad_record, prefetch_restart, ...}."""
+    return {"time": time.time(), "event": "resilience", "kind": kind,
+            **fields}
 
 
 class MetricsLogger:
@@ -28,6 +42,7 @@ class MetricsLogger:
         deflate the per-chip rate). Defaults to jax.device_count()."""
         # multi-host: only process 0 prints and writes the JSONL (every
         # host sees the same replicated loss; racing appends interleave)
+        import jax
         from dalle_pytorch_tpu.parallel.multihost import is_primary
         self.primary = is_primary()
         # the train loops feed host-LOCAL units; per-host work is equalized
@@ -48,6 +63,7 @@ class MetricsLogger:
         self._units_since += units
         if step % self.log_interval != 0:
             return
+        import jax
         now = time.perf_counter()
         dt = max(now - self._t_last, 1e-9)
         rate = self._units_since / dt
@@ -77,6 +93,19 @@ class MetricsLogger:
     def event(self, **fields) -> None:
         """Free-form record (epoch summaries, checkpoint writes...)."""
         rec = {"time": time.time(), **fields}
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+    def resilience(self, kind: str, **fields) -> None:
+        """Structured failure/retry/rollback record — echoed to stdout
+        (these are the events an operator must see even without a JSONL
+        sink) and appended like any other event."""
+        rec = structured_event(kind, **fields)
+        if self.primary:
+            detail = {k: v for k, v in rec.items()
+                      if k not in ("time", "event")}
+            print(f"[resilience] {json.dumps(detail)}", flush=True)
         if self.path:
             with open(self.path, "a") as f:
                 f.write(json.dumps(rec) + "\n")

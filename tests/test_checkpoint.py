@@ -110,6 +110,102 @@ class TestNaming:
         assert leftovers == []
 
 
+@pytest.mark.faults
+class TestCorruptionRecovery:
+    """Partial-write/corruption fallback (ISSUE 1 satellite): a truncated
+    params.msgpack, a missing manifest, and a save killed between the tmp
+    write and the atomic rename must each be DETECTED (validate) and
+    auto-resume must fall back to the previous valid checkpoint."""
+
+    def _two_epochs(self, tmp_path, params):
+        for e in (0, 1):
+            ckpt.save(ckpt.ckpt_path(str(tmp_path), "vae", e), params,
+                      step=e, meta={"epoch": e, "global_step": 2 * (e + 1)})
+
+    def test_truncated_params_detected_and_skipped(self, tmp_path,
+                                                   vae_setup):
+        from dalle_pytorch_tpu.resilience import faults
+        _, params = vae_setup
+        self._two_epochs(tmp_path, params)
+        newest = ckpt.ckpt_path(str(tmp_path), "vae", 1)
+        faults.truncate_params(newest)
+        ok, reason = ckpt.validate(newest)
+        assert not ok and "params.msgpack" in reason
+        path, epoch = ckpt.latest_valid(str(tmp_path), "vae")
+        assert epoch == 0
+        # the naive `latest` would still hand back the corrupt one
+        assert ckpt.latest(str(tmp_path), "vae")[1] == 1
+
+    def test_missing_manifest_detected_and_skipped(self, tmp_path,
+                                                   vae_setup):
+        from dalle_pytorch_tpu.resilience import faults
+        _, params = vae_setup
+        self._two_epochs(tmp_path, params)
+        faults.remove_manifest(ckpt.ckpt_path(str(tmp_path), "vae", 1))
+        ok, reason = ckpt.validate(ckpt.ckpt_path(str(tmp_path), "vae", 1))
+        assert not ok and "manifest" in reason
+        path, epoch = ckpt.latest_valid(str(tmp_path), "vae")
+        assert epoch == 0
+
+    def test_interrupted_save_leaves_previous_valid(self, tmp_path,
+                                                    vae_setup):
+        """Kill between tmp write and rename: the staging dir never
+        matches the name template, the committed checkpoint stays the
+        resume target, and a later save still succeeds."""
+        from dalle_pytorch_tpu.resilience import faults
+        _, params = vae_setup
+        self._two_epochs(tmp_path, params)
+        faults.simulate_interrupted_save(str(tmp_path))
+        path, epoch = ckpt.latest_valid(str(tmp_path), "vae")
+        assert epoch == 1
+        ckpt.save(ckpt.ckpt_path(str(tmp_path), "vae", 2), params, step=2)
+        assert ckpt.latest_valid(str(tmp_path), "vae")[1] == 2
+
+    def test_corrupt_opt_state_detected(self, tmp_path, vae_setup):
+        cfg, params = vae_setup
+        opt = optax.adam(1e-3)
+        path = ckpt.save(str(tmp_path / "c"), params,
+                         opt_state=opt.init(params))
+        with open(os.path.join(path, ckpt.OPT_STATE), "r+b") as f:
+            f.truncate(8)
+        ok, reason = ckpt.validate(path)
+        assert not ok and "opt_state" in reason
+
+    def test_restore_falls_back_through_validate(self, tmp_path, vae_setup):
+        """The full loop: corrupt the newest, restore from what
+        latest_valid picks — bytes round-trip from the older epoch."""
+        from dalle_pytorch_tpu.resilience import faults
+        _, params = vae_setup
+        self._two_epochs(tmp_path, params)
+        faults.truncate_params(ckpt.ckpt_path(str(tmp_path), "vae", 1))
+        path, _ = ckpt.latest_valid(str(tmp_path), "vae")
+        restored, manifest = ckpt.restore_params(path)
+        assert tree_equal(params, restored)
+        assert manifest["meta"]["epoch"] == 0
+
+
+class TestStepCheckpoints:
+    def test_step_template_invisible_to_epoch_latest(self, tmp_path,
+                                                     vae_setup):
+        _, params = vae_setup
+        ckpt.save(ckpt.ckpt_path(str(tmp_path), "vae", 0), params)
+        ckpt.save(ckpt.step_ckpt_path(str(tmp_path), "vae", 7), params)
+        assert ckpt.latest(str(tmp_path), "vae")[1] == 0       # epoch only
+        assert [s for s, _ in ckpt.step_checkpoints(
+            str(tmp_path), "vae")] == [7]
+
+    def test_gc_keeps_newest_steps_never_epochs(self, tmp_path, vae_setup):
+        _, params = vae_setup
+        ckpt.save(ckpt.ckpt_path(str(tmp_path), "vae", 0), params)
+        for s in (1, 2, 3, 4, 5):
+            ckpt.save(ckpt.step_ckpt_path(str(tmp_path), "vae", s), params)
+        removed = ckpt.gc_steps(str(tmp_path), "vae", keep=2)
+        assert len(removed) == 3
+        assert [s for s, _ in ckpt.step_checkpoints(
+            str(tmp_path), "vae")] == [4, 5]
+        assert ckpt.latest(str(tmp_path), "vae")[1] == 0       # untouched
+
+
 class TestCrossCLIContract:
     def test_vae_to_dalle_codebook_tie(self, tmp_path, vae_setup):
         """train_vae writes; train_dalle restores and ties image_emb to the
