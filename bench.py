@@ -1213,6 +1213,124 @@ def bench_moe(args):
     }
 
 
+def bench_serve(args):
+    """Serving-path bench: the continuous-batching engine
+    (dalle_pytorch_tpu/serve) under an offered-load sweep. For each load
+    point, requests arrive on a deterministic schedule (inter-arrival =
+    1/rps) while ONE engine drains them; the record carries throughput,
+    p50/p95 end-to-end latency, slot occupancy, and reject counts. The
+    engine (and its jit cache) is shared across load points, so
+    ``decode_compiles`` must read 1 for the whole sweep — the no-per-
+    request-recompile contract, asserted here, not just measured
+    (docs/SERVING.md methodology)."""
+    import statistics as stats_mod
+
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.models import dalle as D
+    from dalle_pytorch_tpu.serve import QueueFull, Request, RequestQueue, \
+        SamplingParams
+    from dalle_pytorch_tpu.serve.engine import Engine
+
+    cfg = build_cfg(args.tiny, depth=12 if not args.tiny else 2)
+    key = jax.random.PRNGKey(0)
+    params = jax.device_put(D.dalle_init(key, cfg, dtype=jnp.bfloat16))
+
+    num_slots = args.serve_slots
+    n_req = args.serve_requests
+    try:
+        loads = [float(x) for x in args.serve_loads.split(",")]
+    except ValueError:
+        raise ValueError(f"--serve_loads must be comma-separated numbers, "
+                         f"got {args.serve_loads!r}")
+    if any(rps <= 0 for rps in loads):
+        # rps divides the inter-arrival gap below; 0 would ZeroDivide
+        # mid-sweep after the expensive warmup
+        raise ValueError(f"--serve_loads entries must be > 0, got "
+                         f"{args.serve_loads!r}")
+    # one queue/engine pair for the whole sweep: the decode program and
+    # the per-prompt-length prefill programs compile once, ever
+    queue = RequestQueue(max_depth=2 * num_slots)
+    engine = Engine(params, cfg, queue, num_slots=num_slots)
+    prompt_len = min(4, cfg.text_seq_len)
+    tokens_per_req = cfg.seq_len - prompt_len
+
+    _progress(f"serve: compiling prefill + slot-batched decode "
+              f"({num_slots} slots, seq {cfg.seq_len})")
+    # warm the jit cache outside the timed region (same discipline as
+    # time_steps' warmup)
+    h = queue.submit(Request(codes=(1,) * prompt_len, seed=0,
+                             sampling=SamplingParams()))
+    engine.run_until_idle()
+    h.result(timeout=60)
+
+    results = []
+    for rps in loads:
+        base = {"offered_rps": rps, "requests": n_req}
+        occ0, steps0 = engine.occupancy_sum, engine.decode_steps
+        completed, rejected = [], 0
+        t0 = time.perf_counter()
+        next_arrival, submitted = t0, 0
+        pending = []
+        while submitted < n_req or pending:
+            now = time.perf_counter()
+            while submitted < n_req and now >= next_arrival:
+                try:
+                    pending.append(queue.submit(Request(
+                        codes=(1 + submitted % 7,) * prompt_len,
+                        seed=submitted, sampling=SamplingParams())))
+                except QueueFull:
+                    rejected += 1       # structured shed — counted, typed
+                submitted += 1
+                next_arrival += 1.0 / rps
+            engine.step_once()
+            done = [h for h in pending if h.done()]
+            for h in done:
+                completed.append(h.result())
+                pending.remove(h)
+        wall = time.perf_counter() - t0
+        lats = sorted(r.total_s for r in completed if r.ok)
+        n_ok = len(lats)
+        base.update({
+            "completed": n_ok, "rejected": rejected,
+            "throughput_imgs_per_s": round(n_ok / wall, 3),
+            "tokens_per_s": round(n_ok * tokens_per_req / wall, 1),
+            "p50_latency_ms": round(1e3 * stats_mod.median(lats), 1)
+            if lats else None,
+            "p95_latency_ms": round(
+                1e3 * lats[min(int(0.95 * n_ok), n_ok - 1)], 1)
+            if lats else None,
+            "wall_s": round(wall, 2),
+        })
+        # occupancy over THIS load point's steps, not the engine lifetime
+        base["mean_occupancy"] = round(
+            (engine.occupancy_sum - occ0)
+            / max(engine.decode_steps - steps0, 1), 3)
+        results.append(base)
+        _progress(f"serve: rps={rps} done ({n_ok} ok, {rejected} "
+                  f"rejected, {base['wall_s']}s)")
+
+    snap = engine.stats()
+    record = {
+        "metric": "serve engine offered-load sweep (continuous batching)"
+                  if not args.tiny else "tiny serve sweep",
+        "value": results[-1]["throughput_imgs_per_s"],
+        "unit": "imgs/sec at highest load", "vs_baseline": None,
+        "num_slots": num_slots, "seq_len": cfg.seq_len,
+        "prompt_len": prompt_len, "results": results,
+        "decode_compiles": snap["decode_compiles"],
+        "prefill_compiles": snap["prefill_compiles"],
+        "devices": len(jax.devices()), "backend": jax.default_backend(),
+    }
+    if snap["decode_compiles"] != 1:
+        # the one-compile contract IS the point of the fixed-shape slot
+        # pool; a recompile mid-sweep is a correctness failure, not noise
+        record["error"] = (f"decode recompiled: {snap['decode_compiles']} "
+                           "traces for one engine (expected 1)")
+    return record
+
+
 def bench_all(args):
     """Every BASELINE config in one combined JSON object. The north star is
     the top level; each config (north included) records its result or its
@@ -1230,7 +1348,7 @@ def bench_all(args):
     _partial.update(out)
     for name, fn in (("vae", bench_vae), ("rev", bench_rev),
                      ("sparse", bench_sparse), ("moe", bench_moe),
-                     ("kernels", bench_kernels)):
+                     ("kernels", bench_kernels), ("serve", bench_serve)):
         _progress(f"config {name} ...")
         t0 = time.perf_counter()
         try:
@@ -1252,7 +1370,7 @@ def main():
                     help="tiny model for CPU smoke runs (not a benchmark)")
     ap.add_argument("--config", default="all",
                     choices=["all", "north", "vae", "rev", "sparse", "moe",
-                             "kernels"])
+                             "kernels", "serve"])
     ap.add_argument("--attn", default="auto",
                     choices=["auto", "xla", "flash", "flash_pallas",
                              "flash_pallas_fused"],
@@ -1286,6 +1404,15 @@ def main():
                          "per-token weight reads the reference's "
                          "re-forward sampler cannot)")
     ap.add_argument("--retries", type=int, default=3)
+    ap.add_argument("--serve_slots", type=int, default=4,
+                    help="bench_serve: decode slot-pool size (the fixed "
+                         "batch the one compiled program advances)")
+    ap.add_argument("--serve_requests", type=int, default=12,
+                    help="bench_serve: requests per offered-load point")
+    ap.add_argument("--serve_loads", default="2,16",
+                    help="bench_serve: comma list of offered loads "
+                         "(requests/sec) — at least two points for the "
+                         "latency/throughput curve")
     args = ap.parse_args()
     if args.gen_quant and args.no_gen:
         ap.error("--gen_quant needs the generate half; drop --no_gen")
@@ -1330,7 +1457,8 @@ def main():
     try:
         out = {"all": bench_all, "north": bench_north, "vae": bench_vae,
                "rev": bench_rev, "sparse": bench_sparse, "moe": bench_moe,
-               "kernels": bench_kernels}[args.config](args)
+               "kernels": bench_kernels,
+               "serve": bench_serve}[args.config](args)
         _hb["done"] = True
         _emit(out)
     except SystemExit:

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import argparse
 import functools
+import itertools
 import os
+from typing import Optional
 
 import jax
 import numpy as np
@@ -251,31 +253,64 @@ def add_common_args(parser: argparse.ArgumentParser,
                              "before surfacing a structured failure")
 
 
-def make_optimizer(args, steps_per_epoch: int = 0, start_epoch: int = 0):
+def resolve_schedule(args, steps_per_epoch: int = 0, start_epoch: int = 0,
+                     resume_meta: Optional[dict] = None) -> dict:
+    """The LR schedule actually in effect, as a JSON-safe snapshot the
+    CLIs persist in every checkpoint's ``meta['lr_schedule']``.
+
+    The cosine horizon resolves in priority order: an explicit
+    ``--decay_steps`` > the snapshot persisted in the checkpoint being
+    resumed (so an ``--auto_resume`` restart reconstructs the ORIGINAL
+    run's schedule without the user re-passing ``--decay_steps`` or
+    remembering the original ``--n_epochs``) > the default whole-run
+    horizon ``(start_epoch + n_epochs) * steps_per_epoch``."""
+    snap = (resume_meta or {}).get("lr_schedule") or {}
+    decay = 0
+    if args.lr_schedule == "cosine":
+        decay = args.decay_steps or int(snap.get("decay_steps") or 0) \
+            or max((start_epoch + args.n_epochs) * steps_per_epoch
+                   - args.warmup_steps, 1)
+        if snap.get("decay_steps") and args.decay_steps \
+                and int(snap["decay_steps"]) != args.decay_steps:
+            say(f"warning: --decay_steps {args.decay_steps} overrides the "
+                f"resumed run's horizon ({snap['decay_steps']} steps)")
+    return {"schedule": args.lr_schedule, "lr": args.lr,
+            "warmup_steps": args.warmup_steps, "decay_steps": decay,
+            "lr_end_ratio": args.lr_end_ratio,
+            # the run's total horizon in epochs, for operators reading the
+            # manifest (n_epochs is relative to the resume point)
+            "epochs_total": int(snap.get("epochs_total")
+                                or (start_epoch + args.n_epochs))}
+
+
+def make_optimizer(args, steps_per_epoch: int = 0, start_epoch: int = 0,
+                   schedule: Optional[dict] = None):
     """optax.adam under the requested LR schedule (add_common_args flags).
 
     The schedule rides the optimizer's step count, which is part of the
     checkpointed opt state — a resumed run continues the schedule where it
-    left off, provided the same flags are passed. The default cosine
-    horizon covers the WHOLE run including already-completed epochs
+    left off, provided the same flags are passed. The cosine horizon comes
+    from ``schedule`` (a ``resolve_schedule`` snapshot — pass the one built
+    against the resume meta so --auto_resume reconstructs the original
+    horizon); without one it is resolved here from the flags alone, where
+    the default covers the WHOLE run including already-completed epochs
     (``(start_epoch + n_epochs) * steps_per_epoch``), so callers must
-    resolve the resume epoch before building the optimizer; an explicit
-    ``--decay_steps`` overrides. ``--clip_grad_norm`` chains a global-norm
-    clip before adam. The reference has no equivalent of either
-    (fixed-LR unclipped Adam: trainVAE.py:69, trainDALLE.py:166)."""
+    resolve the resume epoch before building the optimizer.
+    ``--clip_grad_norm`` chains a global-norm clip before adam. The
+    reference has no equivalent of either (fixed-LR unclipped Adam:
+    trainVAE.py:69, trainDALLE.py:166)."""
     import optax
+    if schedule is None:
+        schedule = resolve_schedule(args, steps_per_epoch, start_epoch)
     if args.lr_schedule == "constant" and not args.warmup_steps:
         sched = args.lr
     elif args.lr_schedule == "constant":
         sched = optax.linear_schedule(0.0, args.lr, args.warmup_steps)
     else:
-        decay = args.decay_steps or max(
-            (start_epoch + args.n_epochs) * steps_per_epoch
-            - args.warmup_steps, 1)
         sched = optax.warmup_cosine_decay_schedule(
             init_value=0.0, peak_value=args.lr,
             warmup_steps=args.warmup_steps,
-            decay_steps=args.warmup_steps + decay,
+            decay_steps=args.warmup_steps + schedule["decay_steps"],
             end_value=args.lr * args.lr_end_ratio)
     clip = getattr(args, "clip_grad_norm", 0.0)
     if clip and clip > 0:
@@ -352,6 +387,127 @@ def ema_as(ema, params):
     """Cast an f32 EMA tree to the dtypes of ``params`` for eval/decode."""
     import jax
     return jax.tree.map(lambda e, p: e.astype(p.dtype), ema, params)
+
+
+class LoopState:
+    """Mutable loop position the training CLIs and their ``save_state``
+    closures share with ``run_supervised_loop``. The driver advances it;
+    a CLI's checkpoint writer reads it live (mid-epoch saves need the
+    exact position, docs/RESILIENCE.md)."""
+
+    def __init__(self, epoch: int = 0, global_step: int = 0):
+        self.epoch = epoch
+        self.global_step = global_step
+        self.epoch_i = 0          # TRAINED steps completed in current epoch
+        self.train_loss = 0.0     # epoch-summary accumulators
+        self.n_batches = 0
+        self.rec_base = 0         # SOURCE records consumed before this
+        self.pf = None            # epoch's prefetcher was built
+        self.last = None          # payload of the last GOOD step
+
+    @property
+    def records_in_epoch(self) -> int:
+        """SOURCE records consumed this epoch (bad skipped records
+        included) — what checkpoint meta persists for mid-epoch resume;
+        diverges from ``epoch_i`` under --max_bad_records."""
+        return self.rec_base + (self.pf.source_pos
+                                if self.pf is not None else 0)
+
+
+def run_supervised_loop(args, *, sup, metrics, profiler, dataset, plan,
+                        state: LoopState, train_step, on_rollback,
+                        on_epoch_end, transform=None, units_of=None,
+                        unit_name: str = "tokens", avg_fmt: str = ".4f"):
+    """The supervised epoch loop all three training CLIs share: mid-epoch
+    skip + accumulator restore, prefetched iteration, the supervisor's
+    per-step protocol (fault hooks / NaN-spike rollback / cadence and
+    preemption checkpoints), metrics, epoch summaries, and the clean
+    ``Preempted`` exit. The CLIs keep what actually differs — batch
+    assembly and the epoch tail — as callbacks:
+
+      * ``train_step(item, state) -> (loss_like, payload)`` builds the
+        sharded batch (routing it through ``sup.pre_step``), runs the jit
+        step (rebinding its params/opt-state closure cells), and returns
+        the step loss plus a payload the epoch tail may want (kept on
+        ``state.last``, good steps only);
+      * ``on_rollback(state)`` restores params/opt state/EMA from the
+        supervisor's newest valid anchor (``restore_rollback``);
+      * ``on_epoch_end(state, avg) -> checkpoint path`` runs the epoch
+        tail (temperature schedule, recon grid / sample, the epoch save)
+        and returns the written checkpoint for anchor registration;
+      * ``units_of(item)`` sizes the throughput counter; ``transform``
+        feeds ``data.prefetch`` (host-side decode off the iterator
+        thread).
+
+    The driver owns ``state``; resume exactness (zero duplicated or
+    skipped steps) holds exactly as before the extraction —
+    tests/test_faults.py pins it end-to-end."""
+    from dalle_pytorch_tpu.data import prefetch
+    from dalle_pytorch_tpu.resilience import Preempted
+
+    start_epoch = state.epoch
+    skip0 = plan["skip_batches"] if plan else 0
+    mid_meta = plan["meta"] if (plan and plan["mid_epoch"]) else {}
+    try:
+        for epoch in range(start_epoch, start_epoch + args.n_epochs):
+            state.epoch = epoch
+            skip = skip0 if epoch == start_epoch else 0
+            # a mid-epoch resume restores the interrupted epoch's summary
+            # accumulators so avg_loss covers every step exactly once
+            state.train_loss = float(mid_meta.get("train_loss", 0.0)) \
+                if skip else 0.0
+            state.n_batches = int(mid_meta.get("n_batches", 0)) \
+                if skip else 0
+            # epoch_i counts TRAINED steps; skip counts SOURCE records
+            state.epoch_i = int(mid_meta.get("step_in_epoch", skip)) \
+                if skip else 0
+            state.rec_base, state.pf = skip, None
+            it = dataset.epoch(epoch)
+            if skip:
+                # deterministic per-epoch order (seeded stateless
+                # shuffle): skipping the completed prefix replays nothing
+                it = itertools.islice(it, skip, None)
+            state.pf = prefetch(it, depth=2, transform=transform,
+                                max_bad_records=args.max_bad_records,
+                                on_event=lambda r: metrics.event(**r))
+            for item in state.pf:
+                gs = state.global_step
+                profiler.maybe_start(gs)
+                loss, payload = train_step(item, state)
+                profiler.maybe_stop(gs)
+                lv = float(loss)
+                if sup.check_step(gs, lv) == sup.ROLLBACK:
+                    on_rollback(state)
+                    state.global_step += 1
+                    state.epoch_i += 1
+                    continue
+                metrics.step(gs, lv, epoch=epoch,
+                             units=units_of(item) if units_of else 0,
+                             unit_name=unit_name)
+                state.train_loss += lv
+                state.n_batches += 1
+                state.global_step += 1
+                state.epoch_i += 1
+                state.last = payload
+                sup.end_step(state.global_step)
+            if state.n_batches == 0:
+                raise RuntimeError("empty dataset epoch")
+
+            avg = state.train_loss / state.n_batches
+            say(f"====> Epoch: {epoch} Average loss: {avg:{avg_fmt}}")
+            state.epoch_i = 0  # epoch complete: saved meta must say so
+            path = on_epoch_end(state, avg)
+            if path:
+                sup.register_checkpoint(path)
+            mid_meta = {}
+            skip0 = 0
+    except Preempted as p:
+        say(f"preempted — state saved to {p.path}; restart with "
+            "--auto_resume to continue")
+        return
+    finally:
+        sup.close()
+        profiler.close()
 
 
 def load_caption_dataset(args):

@@ -1,0 +1,134 @@
+"""Serving CLI — the continuous-batching engine behind an HTTP front-end.
+
+Where ``gen_dalle`` pays compile + prefill + full decode per invocation,
+this keeps ONE warm engine: the slot-batched decode program compiles once
+at startup, then requests stream through the slot pool (docs/SERVING.md).
+Checkpoint loading follows gen_dalle's contract exactly (DALLE checkpoint
+points at its VAE via meta.vae_checkpoint; vocab JSON from train_dalle;
+optional CLIP for scoring; optional EMA weights; optional int8 weight/KV
+quantization).
+
+Run: python -m dalle_pytorch_tpu.cli.serve --name test --dalle_epoch 99 \
+        --port 8000
+Then: curl -s localhost:8000/generate -d '{"caption": "a flower"}'
+      curl -s localhost:8000/stats
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+
+from dalle_pytorch_tpu import checkpoint as ckpt
+from dalle_pytorch_tpu.cli.common import ema_as, say
+from dalle_pytorch_tpu.data import Vocabulary, read_captions_only
+from dalle_pytorch_tpu.models import dalle as D
+from dalle_pytorch_tpu.utils import MetricsLogger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="serve text->image generation (continuous batching)")
+    p.add_argument("--name", type=str, default="test",
+                   help="DALLE experiment name (as given to train_dalle)")
+    p.add_argument("--dalle_epoch", type=int, default=0)
+    p.add_argument("--models_dir", type=str, default="./models")
+    p.add_argument("--vocab", type=str, default="",
+                   help="vocab JSON (default: {models_dir}/{name}-vocab.json)")
+    p.add_argument("--captions_only", type=str, default="",
+                   help="rebuild vocab from this corpus instead")
+    p.add_argument("--clip_name", type=str, default="",
+                   help="CLIP checkpoint name for result scoring")
+    p.add_argument("--clip_epoch", type=int, default=0)
+    p.add_argument("--use_ema", action="store_true",
+                   help="serve the checkpoint's EMA weights")
+    p.add_argument("--quantize", choices=("none", "int8", "int8_kv"),
+                   default="none",
+                   help="int8 transformer/head weights; int8_kv also "
+                        "stores the slot-pool KV cache int8 (gen_dalle's "
+                        "flags, engine-wide here)")
+    p.add_argument("--num_slots", type=int, default=4,
+                   help="decode slot-pool size — the fixed batch the one "
+                        "compiled decode program advances every step")
+    p.add_argument("--queue_depth", type=int, default=64,
+                   help="bounded admission queue; submissions past this "
+                        "are rejected with a structured 429")
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--metrics", type=str, default="",
+                   help="JSONL metrics file (engine stats + structured "
+                        "serve events)")
+    p.add_argument("--log_every", type=int, default=50,
+                   help="emit an engine-stats record every N decode steps")
+    p.add_argument("--init_deadline_s", type=float, default=300.0,
+                   help="bound backend bring-up per attempt (0 = "
+                        "unbounded), with backoff+jitter retries")
+    p.add_argument("--init_retries", type=int, default=3)
+    return p
+
+
+def load_vocab(args):
+    if args.captions_only:
+        return Vocabulary.from_captions(read_captions_only(
+            args.captions_only))
+    path = args.vocab or os.path.join(args.models_dir,
+                                      f"{args.name}-vocab.json")
+    return Vocabulary.load(path)
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    dalle_path = ckpt.ckpt_path(args.models_dir, f"{args.name}_dalle",
+                                args.dalle_epoch)
+    params, manifest = ckpt.restore_params(dalle_path)
+    cfg = ckpt.dalle_config_from_manifest(manifest)
+    vae_path = manifest["meta"].get("vae_checkpoint")
+    if not vae_path or not os.path.isdir(vae_path):
+        raise FileNotFoundError(
+            f"DALLE checkpoint {dalle_path} does not point at a VAE "
+            "checkpoint (meta.vae_checkpoint)")
+    vae_params, _ = ckpt.restore_params(vae_path)
+    if args.use_ema:
+        ema = ckpt.restore_ema(dalle_path)
+        if ema is None:
+            raise FileNotFoundError(
+                f"{dalle_path} has no EMA weights — train with "
+                "--ema_decay to serve an EMA")
+        params = ema_as(ema, params)
+        say("serving EMA weights")
+    params = jax.device_put(params)
+    vae_params = jax.device_put(vae_params)
+    if args.quantize in ("int8", "int8_kv"):
+        params = D.quantize_for_decode(params)
+
+    clip_params, clip_cfg = None, None
+    if args.clip_name:
+        from dalle_pytorch_tpu.models.clip import CLIPConfig
+        clip_path = ckpt.ckpt_path(args.models_dir, args.clip_name,
+                                   args.clip_epoch)
+        clip_params, clip_manifest = ckpt.restore_params(clip_path)
+        clip_params = jax.device_put(clip_params)
+        clip_cfg = CLIPConfig(**clip_manifest["config"])
+
+    vocab = load_vocab(args)
+    metrics = MetricsLogger(args.metrics or None) if args.metrics else None
+
+    from dalle_pytorch_tpu.serve.server import InferenceServer, serve_http
+    server = InferenceServer(
+        params, vae_params, cfg, num_slots=args.num_slots,
+        queue_depth=args.queue_depth,
+        quantize_cache=args.quantize == "int8_kv",
+        clip_params=clip_params, clip_cfg=clip_cfg, metrics=metrics,
+        log_every=args.log_every, encode=vocab.encode,
+        init_deadline_s=args.init_deadline_s,
+        init_retries=args.init_retries).start()
+    say(f"serving {dalle_path} on http://{args.host}:{args.port} "
+        f"({args.num_slots} slots, queue {args.queue_depth})")
+    serve_http(server, args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,6 @@ Run: python -m dalle_pytorch_tpu.cli.train_clip --dataPath ./imagedata
 from __future__ import annotations
 
 import argparse
-import itertools
 import os
 
 import jax
@@ -25,13 +24,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from dalle_pytorch_tpu import checkpoint as ckpt
-from dalle_pytorch_tpu.cli.common import (add_common_args,
+from dalle_pytorch_tpu.cli.common import (LoopState, add_common_args,
                                           load_caption_dataset, make_ema,
                                           make_optimizer, make_supervisor,
-                                          plan_resume, restore_rollback,
-                                          say, setup_run)
-from dalle_pytorch_tpu.resilience import Preempted
-from dalle_pytorch_tpu.data import load_image_batch, prefetch
+                                          plan_resume, resolve_schedule,
+                                          restore_rollback,
+                                          run_supervised_loop, say,
+                                          setup_run)
+from dalle_pytorch_tpu.data import load_image_batch
 from dalle_pytorch_tpu.models import clip as C
 from dalle_pytorch_tpu.parallel import make_train_step, shard_batch
 from dalle_pytorch_tpu.parallel.train import clip_loss_fn, setup_sharded
@@ -97,8 +97,10 @@ def main(argv=None):
                        steps_per_epoch=len(dataset))
     start_epoch = plan["start_epoch"] if plan else args.start_epoch
     resume_path = plan["path"] if plan else None
-    optimizer = make_optimizer(args, steps_per_epoch=len(dataset),
-                               start_epoch=start_epoch)
+    sched = resolve_schedule(args, steps_per_epoch=len(dataset),
+                             start_epoch=start_epoch,
+                             resume_meta=plan["meta"] if plan else None)
+    optimizer = make_optimizer(args, schedule=sched)
     opt_state = None
     if resume_path:
         params, opt_state, manifest = ckpt.restore_train(resume_path,
@@ -127,21 +129,19 @@ def main(argv=None):
                 "mask": np.asarray(toks) != 0}          # PAD = 0
 
     # mutable loop state the supervisor's save_state closure reads live
-    global_step = plan["global_step"] if plan else 0
-    epoch = start_epoch
-    epoch_i = 0                       # batches completed in current epoch
-    train_loss, n_batches = 0.0, 0
+    # (run_supervised_loop advances it)
+    state = LoopState(epoch=start_epoch,
+                      global_step=plan["global_step"] if plan else 0)
 
     def save_state(path):
         return ckpt.save(
-            path, params, step=global_step, config=cfg,
+            path, params, step=state.global_step, config=cfg,
             opt_state=opt_state, kind="clip",
-            meta={"epoch": epoch, "step_in_epoch": epoch_i,
-                  "global_step": global_step,
-                  "records_in_epoch": rec_base + (
-                      pf.source_pos if pf is not None else 0),
-                  "train_loss": train_loss,
-                  "n_batches": n_batches,
+            meta={"epoch": state.epoch, "step_in_epoch": state.epoch_i,
+                  "global_step": state.global_step,
+                  "records_in_epoch": state.records_in_epoch,
+                  "train_loss": state.train_loss,
+                  "n_batches": state.n_batches, "lr_schedule": sched,
                   **({"ema_decay": args.ema_decay} if ema is not None
                      else {})}, ema=ema)
 
@@ -151,73 +151,41 @@ def main(argv=None):
         # anchor — without it a NaN before the first cadence/epoch
         # save after resume would raise instead of rolling back
         sup.register_checkpoint(resume_path)
-    skip0 = plan["skip_batches"] if plan else 0
-    mid_meta = plan["meta"] if (plan and plan["mid_epoch"]) else {}
-    try:
-        for epoch in range(start_epoch, start_epoch + args.n_epochs):
-            skip = skip0 if epoch == start_epoch else 0
-            train_loss = float(mid_meta.get("train_loss", 0.0)) if skip \
-                else 0.0
-            n_batches = int(mid_meta.get("n_batches", 0)) if skip else 0
-            # epoch_i counts TRAINED steps; skip counts SOURCE records
-            epoch_i = int(mid_meta.get("step_in_epoch", skip)) \
-                if skip else 0
-            rec_base, pf = skip, None
-            it = dataset.epoch(epoch)
-            if skip:
-                it = itertools.islice(it, skip, None)
-            pf = prefetch(it, depth=2, transform=load_batch,
-                          max_bad_records=args.max_bad_records,
-                          on_event=lambda r: metrics.event(**r))
-            for hosted in pf:
-                batch = shard_batch(mesh, hosted)
-                batch = sup.pre_step(global_step, batch)
-                profiler.maybe_start(global_step)
-                params, opt_state, loss = step(
-                    params, opt_state, batch,
-                    jax.random.fold_in(key, global_step))
-                if ema is not None:
-                    ema = ema_update(ema, params)
-                profiler.maybe_stop(global_step)
-                lv = float(loss)
-                if sup.check_step(global_step, lv) == sup.ROLLBACK:
-                    params, opt_state, ema = restore_rollback(
-                        sup, optimizer, mesh)
-                    global_step += 1
-                    epoch_i += 1
-                    continue
-                metrics.step(global_step, lv, epoch=epoch,
-                             units=args.batchSize, unit_name="pairs")
-                train_loss += lv
-                n_batches += 1
-                global_step += 1
-                epoch_i += 1
-                sup.end_step(global_step)
-            if n_batches == 0:
-                raise RuntimeError("empty dataset epoch")
 
-            avg = train_loss / n_batches
-            say(f"====> Epoch: {epoch} Average loss: {avg:.4f}")
-            epoch_i = 0        # epoch complete: saved meta must say so
-            path = ckpt.save(
-                ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
-                step=epoch, config=cfg, opt_state=opt_state, kind="clip",
-                meta={"epoch": epoch, "avg_loss": avg,
-                      "global_step": global_step,
-                      **({"ema_decay": args.ema_decay} if ema is not None
-                         else {})}, ema=ema)
-            sup.register_checkpoint(path)
-            metrics.event(event="checkpoint", path=path, epoch=epoch,
-                          avg_loss=avg)
-            mid_meta = {}
-            skip0 = 0
-    except Preempted as p:
-        say(f"preempted — state saved to {p.path}; restart with "
-            "--auto_resume to continue")
-        return
-    finally:
-        sup.close()
-        profiler.close()
+    def train_step(hosted, state):
+        nonlocal params, opt_state, ema
+        batch = shard_batch(mesh, hosted)
+        batch = sup.pre_step(state.global_step, batch)
+        params, opt_state, loss = step(
+            params, opt_state, batch,
+            jax.random.fold_in(key, state.global_step))
+        if ema is not None:
+            ema = ema_update(ema, params)
+        return loss, None
+
+    def on_rollback(state):
+        nonlocal params, opt_state, ema
+        params, opt_state, ema = restore_rollback(sup, optimizer, mesh)
+
+    def on_epoch_end(state, avg):
+        epoch = state.epoch
+        path = ckpt.save(
+            ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
+            step=epoch, config=cfg, opt_state=opt_state, kind="clip",
+            meta={"epoch": epoch, "avg_loss": avg,
+                  "global_step": state.global_step, "lr_schedule": sched,
+                  **({"ema_decay": args.ema_decay} if ema is not None
+                     else {})}, ema=ema)
+        metrics.event(event="checkpoint", path=path, epoch=epoch,
+                      avg_loss=avg)
+        return path
+
+    run_supervised_loop(
+        args, sup=sup, metrics=metrics, profiler=profiler, dataset=dataset,
+        plan=plan, state=state, train_step=train_step,
+        on_rollback=on_rollback, on_epoch_end=on_epoch_end,
+        transform=load_batch, units_of=lambda item: args.batchSize,
+        unit_name="pairs")
 
 
 if __name__ == "__main__":

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import argparse
 import functools
-import itertools
 import os
 
 import jax
@@ -33,12 +32,14 @@ import numpy as np
 import optax
 
 from dalle_pytorch_tpu import checkpoint as ckpt
-from dalle_pytorch_tpu.cli.common import (add_common_args, make_optimizer,
-                                          make_supervisor, plan_resume,
-                                          restore_rollback, say, setup_run)
-from dalle_pytorch_tpu.resilience import Preempted
-from dalle_pytorch_tpu.data import ImageFolderDataset, prefetch, \
-    save_image_grid, shard_for_host
+from dalle_pytorch_tpu.cli.common import (LoopState, add_common_args,
+                                          make_optimizer, make_supervisor,
+                                          plan_resume, resolve_schedule,
+                                          restore_rollback,
+                                          run_supervised_loop, say,
+                                          setup_run)
+from dalle_pytorch_tpu.data import ImageFolderDataset, save_image_grid, \
+    shard_for_host
 from dalle_pytorch_tpu.models import vae as V
 from dalle_pytorch_tpu.parallel import shard_batch
 from dalle_pytorch_tpu.parallel.train import setup_sharded
@@ -140,13 +141,16 @@ def main(argv=None):
     temperature = args.temperature
     # resolve the resume point BEFORE building the optimizer: the cosine
     # horizon must cover already-completed epochs too. --auto_resume picks
-    # the newest VALID checkpoint (mid-epoch step checkpoints included).
+    # the newest VALID checkpoint (mid-epoch step checkpoints included),
+    # whose persisted schedule snapshot reconstructs the original horizon.
     plan = plan_resume(args, args.name, explicit=args.loadVAE,
                        steps_per_epoch=len(dataset))
     start_epoch = plan["start_epoch"] if plan else args.start_epoch
     resume_path = plan["path"] if plan else None
-    optimizer = make_optimizer(args, steps_per_epoch=len(dataset),
-                               start_epoch=start_epoch)
+    sched = resolve_schedule(args, steps_per_epoch=len(dataset),
+                             start_epoch=start_epoch,
+                             resume_meta=plan["meta"] if plan else None)
+    optimizer = make_optimizer(args, schedule=sched)
     opt_state = None
     if resume_path:
         params, opt_state, manifest = ckpt.restore_train(resume_path,
@@ -184,23 +188,23 @@ def main(argv=None):
         return recon, decoded
 
     # mutable loop state the supervisor's save_state closure reads live
-    global_step = plan["global_step"] if plan else 0
-    epoch = start_epoch
-    epoch_i = 0                       # batches completed in current epoch
-    train_loss, n_batches = 0.0, 0
+    # (run_supervised_loop advances it)
+    state = LoopState(epoch=start_epoch,
+                      global_step=plan["global_step"] if plan else 0)
 
     def save_state(path):
         """Full mid-epoch train state — resume needs params, opt state,
         EMA, schedule meta AND the loop position (global_step/epoch/
         step_in_epoch + accumulators for the epoch summary)."""
         return ckpt.save(
-            path, params, step=global_step, config=cfg,
+            path, params, step=state.global_step, config=cfg,
             opt_state=opt_state, kind="vae",
-            meta={"temperature": temperature, "epoch": epoch,
-                  "step_in_epoch": epoch_i, "global_step": global_step,
-                  "records_in_epoch": rec_base + (
-                      pf.source_pos if pf is not None else 0),
-                  "train_loss": train_loss, "n_batches": n_batches,
+            meta={"temperature": temperature, "epoch": state.epoch,
+                  "step_in_epoch": state.epoch_i,
+                  "global_step": state.global_step,
+                  "records_in_epoch": state.records_in_epoch,
+                  "train_loss": state.train_loss,
+                  "n_batches": state.n_batches, "lr_schedule": sched,
                   **({"ema_decay": args.ema_decay} if ema is not None
                      else {})}, ema=ema)
 
@@ -210,103 +214,67 @@ def main(argv=None):
         # anchor — without it a NaN before the first cadence/epoch
         # save after resume would raise instead of rolling back
         sup.register_checkpoint(resume_path)
-    skip0 = plan["skip_batches"] if plan else 0
-    mid_meta = plan["meta"] if (plan and plan["mid_epoch"]) else {}
-    try:
-        for epoch in range(start_epoch, start_epoch + args.n_epochs):
-            skip = skip0 if epoch == start_epoch else 0
-            # a mid-epoch resume restores the interrupted epoch's summary
-            # accumulators so avg_loss covers every step exactly once
-            train_loss = float(mid_meta.get("train_loss", 0.0)) if skip \
-                else 0.0
-            n_batches = int(mid_meta.get("n_batches", 0)) if skip else 0
-            # epoch_i counts TRAINED steps; skip counts SOURCE records
-            epoch_i = int(mid_meta.get("step_in_epoch", skip)) \
-                if skip else 0
-            rec_base, pf = skip, None
-            last_batch = None
-            it = dataset.epoch(epoch)
-            if skip:
-                # deterministic per-epoch order (seeded stateless shuffle):
-                # skipping the completed prefix replays nothing
-                it = itertools.islice(it, skip, None)
-            pf = prefetch(it, depth=2,
-                          max_bad_records=args.max_bad_records,
-                          on_event=lambda r: metrics.event(**r))
-            for images in pf:
-                batch = shard_batch(mesh, {"images": images})
-                batch["temperature"] = jnp.float32(temperature)
-                batch = sup.pre_step(global_step, batch)
-                profiler.maybe_start(global_step)
-                params, opt_state, loss = step(
-                    params, opt_state, batch,
-                    jax.random.fold_in(key, global_step))
-                if ema is not None:
-                    ema = ema_update(ema, params)
-                profiler.maybe_stop(global_step)
-                lv = float(loss)
-                if sup.check_step(global_step, lv) == sup.ROLLBACK:
-                    params, opt_state, ema = restore_rollback(
-                        sup, optimizer, mesh)
-                    global_step += 1
-                    epoch_i += 1
-                    continue
-                metrics.step(global_step, lv, epoch=epoch,
-                             units=images.shape[0], unit_name="images")
-                train_loss += lv
-                n_batches += 1
-                global_step += 1
-                epoch_i += 1
-                last_batch = batch
-                sup.end_step(global_step)
-            if n_batches == 0:
-                raise RuntimeError("empty dataset epoch")
 
-            if args.tempsched:
-                temperature *= dk
-                say("Current temperature: ", temperature)
+    def train_step(images, state):
+        nonlocal params, opt_state, ema
+        batch = shard_batch(mesh, {"images": images})
+        batch["temperature"] = jnp.float32(temperature)
+        batch = sup.pre_step(state.global_step, batch)
+        params, opt_state, loss = step(
+            params, opt_state, batch,
+            jax.random.fold_in(key, state.global_step))
+        if ema is not None:
+            ema = ema_update(ema, params)
+        return loss, batch
 
-            # per-epoch recon grid (input | recon | argmax decode), first 8.
-            # fetch_local: the batch is dp-sharded across (possibly) hosts —
-            # allgather the k rows so every process feeds the jit identical
-            # data (SPMD) and np.asarray never touches non-addressable
-            # shards. A resume that landed exactly on the epoch boundary has
-            # no batch in hand — skip the grid, keep the checkpoint.
-            if last_batch is not None:
-                from dalle_pytorch_tpu.parallel.multihost import fetch_local
-                k = min(8, args.batchSize)
-                imgs = jnp.asarray(fetch_local(last_batch["images"])[:k])
-                recons, decoded = eval_fn(params, imgs,
-                                          jax.random.fold_in(key, epoch),
-                                          jnp.float32(temperature))
-                grid = np.concatenate([np.asarray(imgs), np.asarray(recons),
-                                       np.asarray(decoded)])
-                grid_path = os.path.join(args.results_dir,
-                                         f"{args.name}_epoch_{epoch}.png")
-                save_image_grid(grid, grid_path, nrow=k)
+    def on_rollback(state):
+        nonlocal params, opt_state, ema
+        params, opt_state, ema = restore_rollback(sup, optimizer, mesh)
 
-            avg = train_loss / n_batches
-            say(f"====> Epoch: {epoch} Average loss: {avg:.8f}")
-            epoch_i = 0        # epoch complete: saved meta must say so
-            path = ckpt.save(
-                ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
-                step=epoch, config=cfg, opt_state=opt_state, kind="vae",
-                meta={"temperature": temperature, "epoch": epoch,
-                      "avg_loss": avg, "global_step": global_step,
-                      **({"ema_decay": args.ema_decay} if ema is not None
-                         else {})}, ema=ema)
-            sup.register_checkpoint(path)
-            metrics.event(event="checkpoint", path=path, epoch=epoch,
-                          avg_loss=avg, temperature=temperature)
-            mid_meta = {}
-            skip0 = 0
-    except Preempted as p:
-        say(f"preempted — state saved to {p.path}; restart with "
-            "--auto_resume to continue")
-        return
-    finally:
-        sup.close()
-        profiler.close()
+    def on_epoch_end(state, avg):
+        nonlocal temperature
+        epoch = state.epoch
+        if args.tempsched:
+            temperature *= dk
+            say("Current temperature: ", temperature)
+
+        # per-epoch recon grid (input | recon | argmax decode), first 8.
+        # fetch_local: the batch is dp-sharded across (possibly) hosts —
+        # allgather the k rows so every process feeds the jit identical
+        # data (SPMD) and np.asarray never touches non-addressable
+        # shards. A resume that landed exactly on the epoch boundary has
+        # no batch in hand — skip the grid, keep the checkpoint.
+        if state.last is not None:
+            from dalle_pytorch_tpu.parallel.multihost import fetch_local
+            k = min(8, args.batchSize)
+            imgs = jnp.asarray(fetch_local(state.last["images"])[:k])
+            recons, decoded = eval_fn(params, imgs,
+                                      jax.random.fold_in(key, epoch),
+                                      jnp.float32(temperature))
+            grid = np.concatenate([np.asarray(imgs), np.asarray(recons),
+                                   np.asarray(decoded)])
+            grid_path = os.path.join(args.results_dir,
+                                     f"{args.name}_epoch_{epoch}.png")
+            save_image_grid(grid, grid_path, nrow=k)
+
+        path = ckpt.save(
+            ckpt.ckpt_path(args.models_dir, args.name, epoch), params,
+            step=epoch, config=cfg, opt_state=opt_state, kind="vae",
+            meta={"temperature": temperature, "epoch": epoch,
+                  "avg_loss": avg, "global_step": state.global_step,
+                  "lr_schedule": sched,
+                  **({"ema_decay": args.ema_decay} if ema is not None
+                     else {})}, ema=ema)
+        metrics.event(event="checkpoint", path=path, epoch=epoch,
+                      avg_loss=avg, temperature=temperature)
+        return path
+
+    run_supervised_loop(
+        args, sup=sup, metrics=metrics, profiler=profiler, dataset=dataset,
+        plan=plan, state=state, train_step=train_step,
+        on_rollback=on_rollback, on_epoch_end=on_epoch_end,
+        units_of=lambda images: images.shape[0], unit_name="images",
+        avg_fmt=".8f")
 
 
 if __name__ == "__main__":

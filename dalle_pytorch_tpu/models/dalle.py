@@ -213,6 +213,32 @@ def embed_prompt(params: dict, cfg: DALLEConfig, text: Array,
     return tok
 
 
+def decode_token_embed(params: dict, cfg: DALLEConfig, cur_tok: Array,
+                       pos: Array) -> Array:
+    """Embedding of the token(s) fed at position(s) ``pos`` during KV-cache
+    decoding — the ONE definition shared by ``generate_images``'s scan and
+    the serve engine's slot-batched step (serve/engine.py), so the two
+    samplers cannot diverge. ``cur_tok`` (b,) ids (image ids WITHOUT the
+    text-vocab offset); ``pos`` a traced scalar or a (b,) per-slot vector.
+    Ids are clipped into each table so the off-branch gather of the
+    ``where`` select stays in range."""
+    pos = jnp.asarray(pos)
+    text_e = (jnp.take(params["text_emb"]["w"],
+                       jnp.clip(cur_tok, 0, cfg.num_text_tokens - 1),
+                       axis=0)
+              + jnp.take(params["text_pos_emb"]["w"],
+                         jnp.clip(pos, 0, cfg.text_seq_len - 1), axis=0))
+    img_pos = jnp.clip(pos - cfg.text_seq_len, 0, cfg.image_seq_len - 1)
+    img_e = (jnp.take(params["image_emb"]["w"],
+                      jnp.clip(cur_tok, 0, cfg.num_image_tokens - 1),
+                      axis=0)
+             + image_pos_emb(params, cfg, img_pos))
+    is_text = pos < cfg.text_seq_len
+    if pos.ndim:
+        is_text = is_text[:, None]
+    return jnp.where(is_text, text_e, img_e)
+
+
 def to_logits(params: dict, h: Array) -> Array:
     h = core.layernorm(params["to_logits"]["ln"], h)
     return core.linear(params["to_logits"]["proj"], h)
@@ -499,17 +525,7 @@ def generate_images(params: dict, vae_params: dict, text: Array, *,
             # the null stream's text stays PAD — feeding it the sampled
             # caption would make it conditional
             cur_tok = jnp.where(is_text & uncond_rows, 0, cur_tok)
-        text_e = (jnp.take(params["text_emb"]["w"],
-                           jnp.clip(cur_tok, 0, cfg.num_text_tokens - 1),
-                           axis=0)
-                  + params["text_pos_emb"]["w"][
-                      jnp.clip(pos, 0, cfg.text_seq_len - 1)])
-        img_pos = jnp.clip(pos - cfg.text_seq_len, 0, cfg.image_seq_len - 1)
-        img_e = (jnp.take(params["image_emb"]["w"],
-                          jnp.clip(cur_tok, 0, cfg.num_image_tokens - 1),
-                          axis=0)
-                 + image_pos_emb(params, cfg, img_pos))
-        x = jnp.where(is_text, text_e, img_e)
+        x = decode_token_embed(params, cfg, cur_tok, pos)
 
         h_tok, cache = decode_ops.decode_step(params["transformer"], x, pos,
                                               cache, cfg=tcfg,

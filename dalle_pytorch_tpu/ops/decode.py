@@ -83,7 +83,14 @@ def _store_rows(cache: dict, ks: Array, vs: Array, pos) -> dict:
     """Write K/V rows (depth, b, heads, rows, dh) into the cache starting
     at ``pos`` — the ONE definition of the cache write for prefill and
     decode_step, quantizing iff the cache is the int8 variant (so the
-    two writers can never diverge on layout)."""
+    two writers can never diverge on layout).
+
+    ``pos`` may also be a (b,) vector of per-batch-row positions (then
+    ks/vs must be single rows, rows == 1): each batch row writes its own
+    cache row — the serve engine's continuous-batching step, where every
+    slot sits at a different sequence position (serve/engine.py)."""
+    if getattr(pos, "ndim", 0) == 1:
+        return _store_rows_per_slot(cache, ks, vs, pos)
     if "k_scale" in cache:
         kq, ksc = _quantize_rows(ks)
         vq, vsc = _quantize_rows(vs)
@@ -101,6 +108,36 @@ def _store_rows(cache: dict, ks: Array, vs: Array, pos) -> dict:
         "k": lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, pos, 0)),
         "v": lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, pos, 0)),
     }
+
+
+def _store_rows_per_slot(cache: dict, ks: Array, vs: Array,
+                         pos: Array) -> dict:
+    """Scatter variant of ``_store_rows``: ks/vs are single rows
+    (depth, b, heads, 1, dh) and ``pos`` is (b,) — batch row i writes cache
+    row pos[i] of its own slot. Same quantization contract as the
+    contiguous path (one write definition per layout)."""
+    b = pos.shape[0]
+    bidx = jnp.arange(b)
+
+    def put_rows(buf, rows):
+        # buf (depth, b, heads, L, dh); advanced indices at dims 1 and 3
+        # are non-adjacent, so the update value is (b, depth, heads, dh)
+        return buf.at[:, bidx, :, pos, :].set(
+            jnp.moveaxis(rows[:, :, :, 0, :], 0, 1))
+
+    def put_scales(buf, sc):
+        # buf (depth, b, heads, L); value (b, depth, heads)
+        return buf.at[:, bidx, :, pos].set(
+            jnp.moveaxis(sc[:, :, :, 0], 0, 1))
+
+    if "k_scale" in cache:
+        kq, ksc = _quantize_rows(ks)
+        vq, vsc = _quantize_rows(vs)
+        return {"k": put_rows(cache["k"], kq),
+                "v": put_rows(cache["v"], vq),
+                "k_scale": put_scales(cache["k_scale"], ksc),
+                "v_scale": put_scales(cache["v_scale"], vsc)}
+    return {"k": put_rows(cache["k"], ks), "v": put_rows(cache["v"], vs)}
 
 
 def _full_key_mask(prompt_mask: Optional[Array], batch: int, prompt_len: int,
@@ -192,8 +229,11 @@ def prefill(params: dict, x: Array, *, cfg, total_len: int,
 def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,
                 key_mask: Array) -> Tuple[Array, dict]:
     """Advance one token. x_tok: (b, dim) embedding of the token at position
-    ``pos`` (traced scalar). key_mask: (b, total_len) validity of cache rows
-    (pad-aware; rows >= pos are masked by the causal check regardless).
+    ``pos`` (traced scalar, or a (b,) vector of PER-ROW positions — the
+    serve engine's continuous-batching step, where each slot of the fixed
+    batch sits at its own point in its own sequence). key_mask:
+    (b, total_len) validity of cache rows (pad-aware; rows >= pos are
+    masked by the causal check regardless).
 
     Returns (h_out (b, dim), updated cache).
     """
@@ -201,14 +241,21 @@ def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,
     depth, b, heads, total_len, dh = cache["k"].shape
     sparse_flags = jnp.asarray(cfg.sparse_pattern)
     any_sparse = any(cfg.sparse_pattern)
+    per_slot = getattr(pos, "ndim", 0) == 1
 
     j = jnp.arange(total_len)
-    causal_ok = j < pos                      # strictly-before rows; self added
-    dense_allowed = causal_ok[None, :] & key_mask            # (b, L)
+    # strictly-before rows; self added as the concatenated extra logit
+    causal_ok = (j[None, :] < pos[:, None]) if per_slot \
+        else (j < pos)[None, :]
+    dense_allowed = causal_ok & key_mask                     # (b, L)
     if any_sparse:
         layout = _sparse_layout(cfg, total_len)
-        row = lax.dynamic_slice(layout, (pos, 0), (1, total_len))[0]
-        sparse_allowed = dense_allowed & row[None, :]
+        if per_slot:
+            row = jnp.take(layout, pos, axis=0)              # (b, L)
+            sparse_allowed = dense_allowed & row
+        else:
+            row = lax.dynamic_slice(layout, (pos, 0), (1, total_len))[0]
+            sparse_allowed = dense_allowed & row[None, :]
     else:
         sparse_allowed = dense_allowed
 

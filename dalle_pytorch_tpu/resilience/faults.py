@@ -42,6 +42,11 @@ class FaultPlan:
     sigterm_at_step: int = -1
     # training loop: replace the batch's float leaves with NaN at this step
     nan_at_step: int = -1
+    # training loop: report the STEP LOSS as NaN at this step (corrupt_loss
+    # in the supervisor's check path) — covers training paths whose batch
+    # has no float leaves to poison (train_dalle/train_clip's integer
+    # token ids), where nan_at_step raises instead of firing
+    nan_loss_at_step: int = -1
 
 
 _active: Optional[FaultPlan] = None
@@ -146,8 +151,22 @@ def corrupt_batch(batch, step: int):
         raise FaultInjected(
             f"nan_at_step={step} fired but the batch has no float leaves "
             "to poison (integer token ids?) — this fault cannot simulate "
-            "a NaN loss on this training path")
+            "a NaN loss on this training path; use nan_loss_at_step")
     return out
+
+
+def corrupt_loss(loss: float, step: int) -> float:
+    """Report NaN as the step loss at ``nan_loss_at_step`` — the loss-level
+    injection point (TrainSupervisor.check_step calls it on every step's
+    host-side loss). Unlike ``corrupt_batch`` this never touches device
+    buffers, so it works for EVERY training path — including
+    train_dalle/train_clip, whose integer-only batches have nothing to
+    poison — and exercises exactly the same rollback machinery: the
+    supervisor sees a non-finite loss and restores the newest anchor."""
+    p = _active
+    if p is None or step != p.nan_loss_at_step or not _once("nan_loss"):
+        return loss
+    return float("nan")
 
 
 # ---------------------------------------------------------------------------

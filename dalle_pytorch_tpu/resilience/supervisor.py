@@ -209,6 +209,9 @@ class TrainSupervisor:
         loss; docs/RESILIENCE.md §3 states the cost, and rewinding the
         stream to the anchor instead is a ROADMAP open item). No anchor
         / exhausted budget raises TrainingDiverged."""
+        # loss-level fault injection (nan_loss_at_step): the hook that
+        # reaches training paths whose batches have no float leaves
+        loss = faults.corrupt_loss(loss, step)
         bad_reason = None
         if not math.isfinite(loss):
             bad_reason = f"non-finite loss {loss}"

@@ -1,0 +1,26 @@
+"""Continuous-batching inference: slot-pool engine, admission queue,
+pipelined postprocess, threaded server (docs/SERVING.md).
+
+Import surface kept lazy-friendly: ``scheduler`` pulls no jax, so queue
+types (Request/Result/QueueFull) are importable before a backend exists —
+the same discipline as ``resilience`` (utils/metrics.py note)."""
+
+from dalle_pytorch_tpu.serve.scheduler import (  # noqa: F401
+    CANCELLED, DEADLINE_EXCEEDED, ERROR, OK, REJECTED, QueueFull, Request,
+    RequestHandle, RequestQueue, Result, SamplingParams, ServeRejected)
+
+
+def __getattr__(name):
+    # Engine / PostProcessor / InferenceServer import jax at construction;
+    # defer the module imports so `from dalle_pytorch_tpu import serve`
+    # stays cheap for callers that only need the queue types.
+    if name == "Engine":
+        from dalle_pytorch_tpu.serve.engine import Engine
+        return Engine
+    if name == "PostProcessor":
+        from dalle_pytorch_tpu.serve.postprocess import PostProcessor
+        return PostProcessor
+    if name in ("InferenceServer", "make_http_server", "serve_http"):
+        from dalle_pytorch_tpu.serve import server
+        return getattr(server, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

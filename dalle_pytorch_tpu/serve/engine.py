@@ -1,0 +1,430 @@
+"""Slot-pool continuous-batching decode engine.
+
+The one-shot path (``cli/gen_dalle.py`` -> ``models.dalle.generate_images``)
+pays full compile + prefill + ~1024 sequential decode steps PER REQUEST,
+with no batching across requests. This engine is the serving answer: a
+fixed ``[num_slots]`` decode batch compiled ONCE, where requests join and
+leave every step via masking (the slot-based continuous batching standard
+on TPU — PAPERS.md "Ragged Paged Attention", "Serving Gemma on Cloud
+TPU"):
+
+  * the KV cache is allocated once for all slots
+    (``ops.decode.init_cache`` at batch = num_slots); a freed slot's stale
+    rows are dead by construction (the per-slot causal mask only reads
+    rows < that slot's position, and admission overwrites the whole slot
+    buffer);
+  * every decode step advances ALL slots one token through ONE jitted
+    program with per-slot positions (``ops.decode.decode_step`` with a
+    (num_slots,) ``pos`` vector), per-slot RNG keys, temperature, top-k
+    and top-p — idle slots compute masked garbage, the price of a fixed
+    shape and zero recompiles;
+  * admission batches pending prompts of the same length through one
+    ``ops.decode.prefill`` call and scatters the resulting KV rows into
+    the slot pool (compiled per (prompt_len, group_size) — bounded by the
+    distinct prompt lengths seen, NOT by request count).
+
+Equivalence contract (tests/test_serve.py pins it): for the same params /
+prompt / seed / sampling knobs, a slot's emitted image tokens are
+IDENTICAL to ``generate_images`` at batch 1 — the engine reuses
+``decode_token_embed``/``logits_mask``/``to_logits`` and reimplements only
+the per-slot (traced-parameter) forms of the top-k/top-p filters, which
+are value-identical to ``top_k_filter``/``top_p_filter``. Per-slot
+sampling draws through ``fold_in(request_rng, position)`` exactly as
+``generate_images`` does; ``jax.random.categorical`` over one slot's
+(vocab,) row equals the batch-1 call with the same key.
+
+Not supported per-request: classifier-free guidance (it doubles the
+stream per request; serve a guidance-dedicated engine instead) and padded
+prompt masks (requests carry unpadded codes, gen_dalle's default mode).
+
+The engine is deliberately single-threaded and drivable step-by-step
+(``step_once``) so tests and the bench can run it deterministically;
+``serve.server`` wraps it in a thread for live traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from dalle_pytorch_tpu.serve import scheduler as S
+
+
+def _sample_slots(logits, pred_pos, keys, temp, topk_k, top_p, cfg):
+    """Per-slot sampling: the traced-parameter form of ``generate_images``'s
+    ``sample`` (models/dalle.py) — forbidden-position mask, temperature,
+    top-k OR nucleus filter, categorical — with every knob a (slots,)
+    array instead of a python constant.
+
+    Value-identical to the one-shot path per slot: the top-k threshold is
+    the k-th largest logit (what ``lax.top_k(...)[..., -1:]`` returns)
+    read off a full descending sort so k can vary per slot; the nucleus
+    branch is ``top_p_filter``'s exact math with p broadcast per slot.
+    Both filters are computed every step (fixed shape) and selected per
+    slot. Returns sampled token ids with the text-vocab offset removed
+    for image positions, as ``generate_images`` stores them."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.models import dalle as D
+    from dalle_pytorch_tpu.ops import core
+
+    forbidden = D.logits_mask(cfg)
+    lg = jnp.where(jnp.take(forbidden, pred_pos - 1, axis=0),
+                   core.neg_inf(logits.dtype), logits)
+    lg = lg / temp[:, None]
+
+    sorted_desc = jnp.flip(jnp.sort(lg, axis=-1), axis=-1)
+    kth = jnp.take_along_axis(sorted_desc, (topk_k - 1)[:, None], axis=-1)
+    by_k = jnp.where(lg < kth, core.neg_inf(lg.dtype), lg)
+
+    probs = jax.nn.softmax(sorted_desc.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < top_p[:, None]
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_desc,
+                               jnp.inf).astype(lg.dtype),
+                     axis=-1, keepdims=True)
+    by_p = jnp.where(lg < thresh, core.neg_inf(lg.dtype), lg)
+
+    lg = jnp.where((top_p > 0)[:, None], by_p, by_k)
+    folded = jax.vmap(jax.random.fold_in)(keys, pred_pos)
+    raw = jax.vmap(jax.random.categorical)(folded, lg)
+    is_image = pred_pos >= cfg.text_seq_len
+    return jnp.where(is_image, raw - cfg.num_text_tokens, raw)
+
+
+class _Slot:
+    """Host-side bookkeeping for one slot of the pool."""
+
+    __slots__ = ("handle", "pos", "cur_tok", "emitted", "t_admit")
+
+    def __init__(self, handle: S.RequestHandle, pos: int, cur_tok: int,
+                 t_admit: float):
+        self.handle = handle
+        self.pos = pos
+        self.cur_tok = cur_tok
+        self.emitted: List[int] = []
+        self.t_admit = t_admit
+
+
+class Engine:
+    """The continuous-batching loop. Pulls from a ``scheduler.RequestQueue``,
+    fulfils handles (directly, or through ``complete`` — the postprocess
+    hand-off) with ``scheduler.Result``s."""
+
+    def __init__(self, params: dict, cfg, queue: S.RequestQueue, *,
+                 num_slots: int = 4,
+                 complete: Optional[Callable] = None,
+                 metrics=None, log_every: int = 0,
+                 quantize_cache: bool = False,
+                 clock: Callable[[], float] = time.monotonic):
+        import jax
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu.ops import decode as decode_ops
+
+        self.params = params
+        self.cfg = cfg
+        self.queue = queue
+        self.num_slots = int(num_slots)
+        self.complete = complete
+        self.metrics = metrics
+        self.log_every = int(log_every)
+        self.quantize_cache = bool(quantize_cache)
+        self.clock = clock
+
+        S_ = self.num_slots
+        self.total_len = cfg.seq_len
+        # device state: the slot-pool KV cache lives on device for the
+        # engine's whole life; the small per-slot vectors round-trip the
+        # host every step (the host collects tokens anyway). Cache dtype
+        # follows the embedding table — the dtype that flows into qkv, so
+        # the admission scatter matches what prefill allocates (under
+        # bf16 params an f32 default would promote the whole decode carry)
+        self.cache = decode_ops.init_cache(
+            cfg.transformer, S_, self.total_len,
+            dtype=params["text_emb"]["w"].dtype,
+            quantized=self.quantize_cache)
+        self.key_mask = jnp.ones((S_, self.total_len), bool)
+        # host state (numpy; fixed shapes so the jit never retraces)
+        self.pos = np.zeros((S_,), np.int32)
+        self.cur_tok = np.zeros((S_,), np.int32)
+        self.rng = np.zeros((S_, 2), np.uint32)
+        self.temp = np.ones((S_,), np.float32)
+        self.topk_k = np.ones((S_,), np.int32)
+        self.top_p = np.zeros((S_,), np.float32)
+        self.slots: List[Optional[_Slot]] = [None] * S_
+
+        # counters (stats()/bench_serve read these)
+        self.decode_traces = 0          # bumped only while TRACING: the
+        self.prefill_traces = 0         # fixed-shape contract keeps it at 1
+        self.decode_steps = 0
+        self.tokens_decoded = 0
+        self.completed = 0
+        self.expired = 0
+        self.occupancy_sum = 0
+        self._t_start = None
+
+        self._decode_fn = jax.jit(self._decode_impl)
+        self._prefill_fns: Dict = {}
+        self._lock = threading.Lock()   # step_once is not reentrant
+
+    # -- jitted programs ----------------------------------------------------
+
+    def _decode_impl(self, params, cache, cur_tok, pos, keys, temp,
+                     topk_k, top_p):
+        """One step for ALL slots: embed each slot's current token at its
+        own position, advance the stack once, sample each slot's next
+        token. Traced exactly once (fixed shapes) — the side-effecting
+        counter below proves it."""
+        self.decode_traces += 1
+        from dalle_pytorch_tpu.models import dalle as D
+        from dalle_pytorch_tpu.ops import decode as decode_ops
+
+        x = D.decode_token_embed(params, self.cfg, cur_tok, pos)
+        h, cache = decode_ops.decode_step(
+            params["transformer"], x, pos, cache,
+            cfg=self.cfg.transformer, key_mask=self.key_mask)
+        logits = D.to_logits(params, h)
+        nxt = _sample_slots(logits, pos + 1, keys, temp, topk_k, top_p,
+                            self.cfg)
+        return nxt, cache
+
+    def _prefill_fn(self, t0: int, n: int):
+        """Admission program for a group of ``n`` same-length prompts:
+        batched prefill + scatter of the KV rows into the slot pool +
+        each request's FIRST sampled token (position t0, key
+        ``fold_in(rng, t0)`` — ``generate_images``'s first_tok). Compiled
+        per (t0, n): bounded by distinct prompt lengths, not requests."""
+        import jax
+        import jax.numpy as jnp
+        key = (t0, n)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
+
+        def pre(params, cache, text, slots, keys, temp, topk_k, top_p):
+            self.prefill_traces += 1
+            from dalle_pytorch_tpu.models import dalle as D
+            from dalle_pytorch_tpu.ops import decode as decode_ops
+
+            tokens = D.embed_prompt(params, self.cfg, text)
+            h, group = decode_ops.prefill(
+                params["transformer"], tokens, cfg=self.cfg.transformer,
+                total_len=self.total_len, prompt_mask=None,
+                quantize_cache=self.quantize_cache)
+            cache = {k: cache[k].at[:, slots].set(group[k]) for k in cache}
+            logits = D.to_logits(params, h[:, -1])
+            first = _sample_slots(logits,
+                                  jnp.full((text.shape[0],), t0, jnp.int32),
+                                  keys, temp, topk_k, top_p, self.cfg)
+            return first, cache
+
+        fn = jax.jit(pre)
+        self._prefill_fns[key] = fn
+        return fn
+
+    # -- request lifecycle --------------------------------------------------
+
+    def _finish(self, handle: S.RequestHandle, result: S.Result) -> None:
+        if result.status == S.OK and self.complete is not None:
+            self.complete(handle, result)
+        else:
+            handle.fulfill(result)
+
+    def _expire(self, handle: S.RequestHandle, now: float,
+                where: str) -> None:
+        req = handle.request
+        self.expired += 1
+        if self.metrics is not None:
+            self.metrics.event(**S.structured_event(
+                "serve_deadline", request_id=req.request_id, where=where,
+                deadline_s=req.deadline_s,
+                waited_s=round(now - req.submit_t, 4)))
+        self._finish(handle, S.Result(
+            status=S.DEADLINE_EXCEEDED, request_id=req.request_id,
+            reason=f"deadline_s={req.deadline_s:g} exceeded ({where})",
+            queued_s=round(now - req.submit_t, 6),
+            total_s=round(now - req.submit_t, 6)))
+
+    def _admit(self, handles: List[S.RequestHandle], now: float) -> None:
+        import jax
+        import jax.numpy as jnp
+        free = [i for i, s in enumerate(self.slots) if s is None]
+        assert len(handles) <= len(free)
+        groups = defaultdict(list)
+        for h in handles:
+            groups[len(h.request.codes)].append(h)
+        for t0, group in groups.items():
+            idx = free[:len(group)]
+            free = free[len(group):]
+            text = np.asarray([h.request.codes for h in group], np.int32)
+            slots = np.asarray(idx, np.int32)
+            for i, h in zip(idx, group):
+                req = h.request
+                v = self.cfg.total_tokens
+                self.rng[i] = np.asarray(
+                    jax.random.PRNGKey(req.seed), np.uint32)
+                self.temp[i] = np.float32(req.sampling.temperature)
+                self.topk_k[i] = max(
+                    int((1 - req.sampling.filter_thres) * v), 1)
+                self.top_p[i] = np.float32(req.sampling.top_p)
+            first, self.cache = self._prefill_fn(t0, len(group))(
+                self.params, self.cache, jnp.asarray(text),
+                jnp.asarray(slots), jnp.asarray(self.rng[idx]),
+                jnp.asarray(self.temp[idx]), jnp.asarray(self.topk_k[idx]),
+                jnp.asarray(self.top_p[idx]))
+            first = np.asarray(first)
+            for j, (i, h) in enumerate(zip(idx, group)):
+                self.pos[i] = t0
+                self.cur_tok[i] = first[j]
+                self.slots[i] = _Slot(h, t0, int(first[j]), now)
+
+    def _harvest(self, now: float) -> None:
+        """Complete slots whose sequence is done; free them."""
+        for i, slot in enumerate(self.slots):
+            if slot is None or self.pos[i] < self.total_len:
+                continue
+            req = slot.handle.request
+            full = list(req.codes) + slot.emitted
+            img_seq = np.asarray(full[-self.cfg.image_seq_len:], np.int32)
+            self.completed += 1
+            self._finish(slot.handle, S.Result(
+                status=S.OK, request_id=req.request_id, tokens=img_seq,
+                queued_s=round(slot.t_admit - req.submit_t, 6),
+                decode_s=round(now - slot.t_admit, 6),
+                total_s=round(now - req.submit_t, 6)))
+            self.slots[i] = None
+            # idle slots park at pos 0: they rewrite their dead row 0
+            # instead of scattering past the cache end
+            self.pos[i] = 0
+            self.cur_tok[i] = 0
+
+    # -- the loop -----------------------------------------------------------
+
+    def active_slots(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    def step_once(self) -> bool:
+        """One engine iteration: expire, admit, decode one token on every
+        active slot, harvest. Returns True when any work happened."""
+        import jax.numpy as jnp
+        with self._lock:
+            now = self.clock()
+            if self._t_start is None:
+                self._t_start = now
+
+            # mid-decode deadlines: a slot past its deadline is cancelled
+            # before it spends another step
+            for i, slot in enumerate(self.slots):
+                if slot is None:
+                    continue
+                dt = slot.handle.request.deadline_t
+                if dt is not None and now > dt:
+                    self._expire(slot.handle, now, where="decoding")
+                    self.slots[i] = None
+                    self.pos[i] = 0
+                    self.cur_tok[i] = 0
+
+            free = self.num_slots - self.active_slots()
+            ready, expired = self.queue.pop_ready(free, now)
+            for h in expired:
+                self._expire(h, now, where="queued")
+            if ready:
+                self._admit(ready, now)
+
+            n_active = self.active_slots()
+            if n_active == 0:
+                return bool(ready or expired)
+
+            # every active slot emits its current token, then advances
+            for slot in self.slots:
+                if slot is not None:
+                    slot.emitted.append(int(slot.cur_tok))
+            nxt, self.cache = self._decode_fn(
+                self.params, self.cache, jnp.asarray(self.cur_tok),
+                jnp.asarray(self.pos), jnp.asarray(self.rng),
+                jnp.asarray(self.temp), jnp.asarray(self.topk_k),
+                jnp.asarray(self.top_p))
+            nxt = np.asarray(nxt)
+            for i, slot in enumerate(self.slots):
+                if slot is None:
+                    continue
+                self.pos[i] += 1
+                self.cur_tok[i] = nxt[i]
+                slot.cur_tok = int(nxt[i])
+                slot.pos = int(self.pos[i])
+            self.decode_steps += 1
+            self.tokens_decoded += n_active
+            self.occupancy_sum += n_active
+
+            if (self.metrics is not None and self.log_every
+                    and self.decode_steps % self.log_every == 0):
+                self.metrics.event(event="serve", **self.stats())
+
+            self._harvest(self.clock())
+            return True
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        """Drive until the queue is empty and every slot is free (tests,
+        bench). ``max_steps`` is a runaway guard, not a budget."""
+        for _ in range(max_steps):
+            busy = self.step_once()
+            if not busy and self.queue.depth() == 0 \
+                    and self.active_slots() == 0:
+                return
+        raise RuntimeError(f"engine did not go idle in {max_steps} steps")
+
+    def run(self, stop: threading.Event, idle_sleep_s: float = 0.002):
+        """Serving loop for a dedicated thread (serve.server): spin while
+        there is work, nap briefly when idle."""
+        while not stop.is_set():
+            if not self.step_once() and self.queue.depth() == 0 \
+                    and self.active_slots() == 0:
+                stop.wait(idle_sleep_s)
+
+    def cancel_active(self, reason: str = "server shutdown") -> int:
+        """Fulfil every in-slot request with a typed ``cancelled`` result
+        and free the slots (the shutdown path — the no-hangs contract
+        must cover requests already admitted, not just queued ones).
+        Returns the number cancelled."""
+        n = 0
+        with self._lock:
+            for i, slot in enumerate(self.slots):
+                if slot is None:
+                    continue
+                req = slot.handle.request
+                slot.handle.fulfill(S.Result(
+                    status=S.CANCELLED, request_id=req.request_id,
+                    reason=reason,
+                    queued_s=round(slot.t_admit - req.submit_t, 6)))
+                self.slots[i] = None
+                self.pos[i] = 0
+                self.cur_tok[i] = 0
+                n += 1
+        return n
+
+    # -- observability ------------------------------------------------------
+
+    def stats(self) -> dict:
+        elapsed = None if self._t_start is None \
+            else max(self.clock() - self._t_start, 1e-9)
+        return {
+            "queue_depth": self.queue.depth(),
+            "active_slots": self.active_slots(),
+            "num_slots": self.num_slots,
+            "decode_steps": self.decode_steps,
+            "tokens_decoded": self.tokens_decoded,
+            "tokens_per_s": (round(self.tokens_decoded / elapsed, 2)
+                             if elapsed else 0.0),
+            "mean_occupancy": (round(self.occupancy_sum
+                                     / max(self.decode_steps, 1), 3)),
+            "completed": self.completed,
+            "expired": self.expired,
+            "rejected": self.queue.rejected,
+            "decode_compiles": self.decode_traces,
+            "prefill_compiles": self.prefill_traces,
+        }

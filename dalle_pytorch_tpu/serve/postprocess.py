@@ -1,0 +1,129 @@
+"""Pipelined post-decode stage: VAE image decode + optional CLIP scoring.
+
+The engine's decode loop is sequential-latency bound (one small matmul
+chain per token); the VAE deconvolution stack that turns a finished
+slot's tokens into pixels is a comparatively fat one-shot program. Running
+it inline would stall every OTHER slot in the batch for the duration, so
+completed sequences are handed to this stage's worker thread instead —
+image decoding overlaps token decoding, and the engine's fixed-shape step
+never waits on pixels.
+
+One jitted program per stage (batch-1 VAE decode, batch-1 CLIP score),
+compiled on the first completion and reused — the pipeline adds no
+per-request compiles. The worker fulfils each request's handle with the
+final ``Result`` (tokens + image [+ clip_score]); a postprocess failure
+fulfils the handle with ``status='error'`` instead of dropping it (the
+no-hangs contract extends through the pipeline)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from dalle_pytorch_tpu.serve import scheduler as S
+
+
+class PostProcessor:
+    """Worker-thread stage between the engine and the caller.
+
+    ``submit`` is the engine's ``complete`` hook; ``close`` drains the
+    in-flight queue before returning so no handle is left unfulfilled."""
+
+    def __init__(self, params: dict, vae_params: dict, cfg, *,
+                 clip_params: Optional[dict] = None, clip_cfg=None,
+                 metrics=None, max_pending: int = 64):
+        import jax
+
+        from dalle_pytorch_tpu.models import vae as vae_mod
+
+        self.params = params
+        self.vae_params = vae_params
+        self.cfg = cfg
+        self.clip_params = clip_params
+        self.clip_cfg = clip_cfg
+        self.metrics = metrics
+        self.decoded = 0
+
+        # bounded: a stalled consumer backpressures the engine thread at
+        # submit() instead of growing an unbounded token backlog
+        self._q: queue.Queue = queue.Queue(maxsize=max_pending)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        @jax.jit
+        def _decode(vp, codebook, img_seq):
+            # DALLE owns the tied codebook copy (models/dalle.py docstring)
+            return vae_mod.decode(vp, img_seq, codebook=codebook)
+
+        self._decode = _decode
+        self._score = None
+        if clip_params is not None:
+            from dalle_pytorch_tpu.models import clip as clip_mod
+
+            @jax.jit
+            def _score(cp, text, images):
+                return clip_mod.clip_apply(cp, text, images, cfg=clip_cfg)
+
+            self._score = _score
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "PostProcessor":
+        self._thread = threading.Thread(target=self._work, daemon=True,
+                                        name="serve-postprocess")
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- the engine's completion hook ---------------------------------------
+
+    def submit(self, handle: S.RequestHandle, result: S.Result) -> None:
+        self._q.put((handle, result))
+
+    def pending(self) -> int:
+        return self._q.qsize()
+
+    # -- worker -------------------------------------------------------------
+
+    def _work(self) -> None:
+        import jax.numpy as jnp
+        while not (self._stop.is_set() and self._q.empty()):
+            try:
+                handle, result = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            t0 = time.monotonic()
+            try:
+                img_seq = jnp.asarray(result.tokens)[None]
+                image = self._decode(self.vae_params,
+                                     self.params["image_emb"]["w"], img_seq)
+                result.image = np.asarray(image)[0]
+                if self._score is not None:
+                    req = handle.request
+                    text = np.zeros((1, self.clip_cfg.text_seq_len),
+                                    np.int32)
+                    codes = list(req.codes)[:self.clip_cfg.text_seq_len]
+                    text[0, :len(codes)] = codes
+                    score = self._score(self.clip_params,
+                                        jnp.asarray(text), image)
+                    result.clip_score = float(np.asarray(score)[0])
+                self.decoded += 1
+                result.total_s = round(
+                    result.total_s + (time.monotonic() - t0), 6)
+                handle.fulfill(result)
+            except Exception as e:      # noqa: BLE001 — no-hangs contract
+                handle.fulfill(S.Result(
+                    status=S.ERROR, request_id=result.request_id,
+                    tokens=result.tokens, reason=f"postprocess: {e}"))
+                if self.metrics is not None:
+                    self.metrics.event(**S.structured_event(
+                        "serve_postprocess_error",
+                        request_id=result.request_id, error=str(e)))

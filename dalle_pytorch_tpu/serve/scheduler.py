@@ -1,0 +1,213 @@
+"""Request queue with admission control: priorities, deadlines,
+backpressure.
+
+The serving contract (docs/SERVING.md) is that overload is STRUCTURED:
+a full queue rejects at submit time with a typed error carrying the same
+``utils.metrics.structured_event`` record shape the resilience runtime
+uses, and a request whose deadline passes — in the queue or mid-decode —
+completes with a typed ``deadline_exceeded`` result. Nothing hangs,
+nothing is silently dropped; every terminal state is one of
+``Result.status``'s enumerated strings, observable both by the caller
+(through ``RequestHandle.result``) and post-hoc (through the JSONL
+metrics stream).
+
+Ordering is (priority, arrival): lower ``priority`` values run first,
+FIFO within a priority class. Deadlines do not reorder the queue — a
+deadline is a promise about when a result stops being useful, not a
+scheduling hint — they only gate admission to a slot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from dalle_pytorch_tpu.utils.metrics import structured_event
+
+# Result.status values — the full set of terminal request states.
+OK = "ok"
+REJECTED = "rejected"
+DEADLINE_EXCEEDED = "deadline_exceeded"
+CANCELLED = "cancelled"
+ERROR = "error"
+
+
+class ServeRejected(RuntimeError):
+    """Typed submit-time rejection. ``record`` is the structured event
+    (kind ``serve_reject``) describing why — the backpressure contract's
+    machine-readable half."""
+
+    def __init__(self, record: dict):
+        super().__init__(f"{record.get('reason', 'rejected')} "
+                         f"(queue_depth={record.get('queue_depth')})")
+        self.record = record
+
+
+class QueueFull(ServeRejected):
+    """The bounded queue is at capacity — shed load at the edge instead
+    of letting latency grow without bound."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs — the same surface ``generate_images``
+    exposes (models/dalle.py), carried per slot by the engine."""
+    temperature: float = 1.0
+    filter_thres: float = 0.5
+    top_p: float = 0.0
+
+    def __post_init__(self):
+        if self.temperature <= 0:
+            raise ValueError(f"temperature must be > 0, got "
+                             f"{self.temperature}")
+        if not 0.0 <= self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {self.top_p}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request: ``codes`` is the (unpadded) prompt token
+    ids, exactly what ``generate_images`` takes as one text row."""
+    codes: Tuple[int, ...]
+    seed: int = 0
+    sampling: SamplingParams = SamplingParams()
+    priority: int = 0                    # lower runs first
+    deadline_s: Optional[float] = None   # relative to submit time
+    request_id: int = -1                 # assigned by the queue
+    submit_t: float = 0.0                # monotonic, assigned by the queue
+
+    @property
+    def deadline_t(self) -> Optional[float]:
+        if self.deadline_s is None:
+            return None
+        return self.submit_t + self.deadline_s
+
+
+@dataclasses.dataclass
+class Result:
+    """Terminal state of a request. ``tokens`` is the sampled image-token
+    sequence (image ids, no text offset — ``generate_images``'s
+    ``img_seq``); ``image`` is filled by the postprocess stage when image
+    decoding is enabled."""
+    status: str
+    request_id: int
+    tokens: object = None
+    image: object = None
+    clip_score: Optional[float] = None
+    reason: str = ""
+    queued_s: float = 0.0
+    decode_s: float = 0.0
+    total_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.status == OK
+
+
+class RequestHandle:
+    """Future for one request: ``result(timeout)`` blocks until the
+    engine/postprocess fulfils it. Always fulfilled with a ``Result`` —
+    including rejects and expiries — so callers never hang on overload."""
+
+    def __init__(self, request: Request):
+        self.request = request
+        self._done = threading.Event()
+        self._result: Optional[Result] = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def fulfill(self, result: Result) -> None:
+        self._result = result
+        self._done.set()
+
+    def result(self, timeout: Optional[float] = None) -> Result:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request.request_id} not done after "
+                f"{timeout}s (still queued or decoding)")
+        return self._result
+
+
+class RequestQueue:
+    """Bounded, thread-safe priority queue.
+
+    ``submit`` raises ``QueueFull`` past ``max_depth`` (the structured
+    reject); ``pop_ready`` hands the engine up to ``n`` admissible
+    requests in (priority, arrival) order, separating out entries whose
+    deadline already passed so the engine can fulfil them as
+    ``deadline_exceeded`` without spending a slot."""
+
+    def __init__(self, max_depth: int = 64,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_event=None):
+        self.max_depth = int(max_depth)
+        self.clock = clock
+        self.on_event = on_event
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.rejected = 0
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    def submit(self, request: Request) -> RequestHandle:
+        now = self.clock()
+        with self._lock:
+            if len(self._heap) >= self.max_depth:
+                self.rejected += 1
+                record = structured_event(
+                    "serve_reject", reason="queue_full",
+                    queue_depth=len(self._heap),
+                    max_depth=self.max_depth, priority=request.priority)
+                if self.on_event is not None:
+                    self.on_event(record)
+                raise QueueFull(record)
+            rid = self.submitted
+            self.submitted += 1
+            request = dataclasses.replace(request, request_id=rid,
+                                          submit_t=now)
+            handle = RequestHandle(request)
+            heapq.heappush(self._heap,
+                           (request.priority, next(self._seq), handle))
+            return handle
+
+    def pop_ready(self, n: int,
+                  now: Optional[float] = None
+                  ) -> Tuple[List[RequestHandle], List[RequestHandle]]:
+        """Up to ``n`` (ready, expired) handles. EVERY deadline-expired
+        queued entry is reaped on every call — including ``n == 0`` (a
+        full slot pool): a dead entry must neither hold queue capacity
+        against fresh submissions nor wait for a free slot to receive its
+        typed deadline_exceeded result."""
+        if now is None:
+            now = self.clock()
+        ready: List[RequestHandle] = []
+        dead: list = []
+        with self._lock:
+            keep = []
+            for entry in self._heap:          # reap expired everywhere
+                dt = entry[2].request.deadline_t
+                (dead if dt is not None and now > dt
+                 else keep).append(entry)
+            if dead:
+                heapq.heapify(keep)
+                self._heap = keep
+            while self._heap and len(ready) < n:
+                ready.append(heapq.heappop(self._heap)[2])
+        return ready, [e[2] for e in dead]
+
+    def drain(self) -> List[RequestHandle]:
+        """Remove and return everything still queued (shutdown path — the
+        server fulfils them as ``cancelled``)."""
+        with self._lock:
+            out = [h for _, _, h in self._heap]
+            self._heap.clear()
+        return out

@@ -1,0 +1,279 @@
+"""Serving front-end: the threaded Python API and the stdlib HTTP server.
+
+``InferenceServer`` wires the three pipeline stages together —
+``scheduler.RequestQueue`` (admission) -> ``engine.Engine`` (slot-batched
+decode, its own thread) -> ``postprocess.PostProcessor`` (VAE/CLIP, its
+own thread) — and owns their lifecycle. Backend bring-up goes through the
+SAME deadline/backoff/jitter discipline as every other entry point
+(``resilience.retry``): a wedged TPU claim surfaces as a structured
+``BringupError`` instead of a hung server.
+
+Two call surfaces:
+  * Python: ``submit(codes, ...) -> RequestHandle`` / ``stats()`` — what
+    tests, the bench, and embedders use;
+  * HTTP (``serve_http``): POST /generate {"codes": [...] | "caption":
+    "...", sampling knobs...} blocks for the result (429 on queue-full,
+    504 on deadline, both with the structured record as the JSON body);
+    GET /stats and /healthz for operators. The stdlib ThreadingHTTPServer
+    is deliberate — one dependency-free front-end; a production mesh
+    would sit a real gateway in front of the same Python API.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional
+
+from dalle_pytorch_tpu.serve import engine as engine_mod
+from dalle_pytorch_tpu.serve import postprocess as post_mod
+from dalle_pytorch_tpu.serve import scheduler as S
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile; [] -> 0.0 (no completed requests yet)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+class InferenceServer:
+    """Continuous-batching text->image service over one model replica."""
+
+    def __init__(self, params: dict, vae_params: dict, cfg, *,
+                 num_slots: int = 4, queue_depth: int = 64,
+                 quantize_cache: bool = False,
+                 clip_params: Optional[dict] = None, clip_cfg=None,
+                 decode_images: bool = True,
+                 metrics=None, log_every: int = 50,
+                 encode: Optional[Callable[[str], List[int]]] = None,
+                 init_deadline_s: float = 0.0, init_retries: int = 3):
+        self.cfg = cfg
+        self.metrics = metrics
+        self.encode = encode
+        self.init_deadline_s = init_deadline_s
+        self.init_retries = init_retries
+
+        self.queue = S.RequestQueue(
+            max_depth=queue_depth,
+            on_event=(lambda rec: metrics.event(**rec))
+            if metrics is not None else None)
+        self.post = None
+        if decode_images:
+            self.post = post_mod.PostProcessor(
+                params, vae_params, cfg, clip_params=clip_params,
+                clip_cfg=clip_cfg, metrics=metrics)
+        self.engine = engine_mod.Engine(
+            params, cfg, self.queue, num_slots=num_slots,
+            complete=self._on_decoded, metrics=metrics,
+            log_every=log_every, quantize_cache=quantize_cache)
+
+        # bounded window: p50/p95 over the last 10k completions — an
+        # unbounded list would grow (and re-sort under the lock) forever
+        # on a long-lived server
+        self._latencies: deque = deque(maxlen=10_000)
+        self._lat_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- stage glue ---------------------------------------------------------
+
+    def _on_decoded(self, handle: S.RequestHandle,
+                    result: S.Result) -> None:
+        with self._lat_lock:
+            self._latencies.append(result.total_s)
+        if self.post is not None:
+            self.post.submit(handle, result)
+        else:
+            handle.fulfill(result)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        """Claim the backend (deadline-bounded, retried with backoff) and
+        launch the engine + postprocess threads."""
+        from dalle_pytorch_tpu.resilience import retry as rretry
+
+        def claim(attempt):
+            from dalle_pytorch_tpu.resilience import faults
+            faults.maybe_activate_from_env()
+            faults.on_backend_init(attempt)
+            import jax
+            return jax.devices()
+
+        policy = rretry.RetryPolicy(
+            max_attempts=max(self.init_retries, 1),
+            deadline_s=self.init_deadline_s or None)
+        rretry.retry_with_backoff(
+            claim, policy, label="serve_backend_init",
+            on_event=(lambda rec: self.metrics.resilience(
+                rec.get("kind", "bringup_retry"),
+                **{k: v for k, v in rec.items()
+                   if k not in ("time", "event", "kind")})
+            ) if self.metrics is not None else None)
+
+        if self.post is not None:
+            self.post.start()
+        self._thread = threading.Thread(
+            target=self.engine.run, args=(self._stop,), daemon=True,
+            name="serve-engine")
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop the engine, then cancel everything still queued AND
+        everything mid-decode in a slot (typed results — the no-hangs
+        contract holds through shutdown for admitted requests too), then
+        drain the postprocess stage."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        for handle in self.queue.drain():
+            handle.fulfill(S.Result(
+                status=S.CANCELLED,
+                request_id=handle.request.request_id,
+                reason="server shutdown"))
+        # after the engine thread stopped: slots still holding requests
+        # would otherwise leave their callers blocked in result()
+        self.engine.cancel_active("server shutdown")
+        if self.post is not None:
+            self.post.close(timeout)
+
+    # -- the Python API -----------------------------------------------------
+
+    def submit(self, codes, *, seed: int = 0, temperature: float = 1.0,
+               filter_thres: float = 0.5, top_p: float = 0.0,
+               priority: int = 0,
+               deadline_s: Optional[float] = None) -> S.RequestHandle:
+        """Enqueue one generation request. Raises ``scheduler.QueueFull``
+        (typed, structured) on backpressure."""
+        return self.queue.submit(S.Request(
+            codes=tuple(int(c) for c in codes), seed=seed,
+            sampling=S.SamplingParams(temperature=temperature,
+                                      filter_thres=filter_thres,
+                                      top_p=top_p),
+            priority=priority, deadline_s=deadline_s))
+
+    def generate(self, codes, timeout: Optional[float] = None,
+                 **kwargs) -> S.Result:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(codes, **kwargs).result(timeout)
+
+    def stats(self) -> dict:
+        with self._lat_lock:
+            lats = sorted(self._latencies)
+        out = self.engine.stats()
+        out.update({
+            "requests_submitted": self.queue.submitted,
+            "p50_latency_s": round(_percentile(lats, 0.50), 4),
+            "p95_latency_s": round(_percentile(lats, 0.95), 4),
+            "postprocess_pending": (self.post.pending()
+                                    if self.post is not None else 0),
+        })
+        return out
+
+
+# ---------------------------------------------------------------------------
+# HTTP front-end
+# ---------------------------------------------------------------------------
+
+_HTTP_STATUS = {S.OK: 200, S.REJECTED: 429, S.DEADLINE_EXCEEDED: 504,
+                S.CANCELLED: 503, S.ERROR: 500}
+
+
+def _result_body(result: S.Result) -> dict:
+    body = {"status": result.status, "request_id": result.request_id,
+            "reason": result.reason, "queued_s": result.queued_s,
+            "decode_s": result.decode_s, "total_s": result.total_s}
+    if result.tokens is not None:
+        body["tokens"] = [int(t) for t in result.tokens]
+    if result.image is not None:
+        # pixel grids are bulky as JSON; ship shape + the PNG-side is the
+        # CLI's job (cli/serve.py --results_dir). Scores ride along.
+        body["image_shape"] = list(result.image.shape)
+    if result.clip_score is not None:
+        body["clip_score"] = result.clip_score
+    return body
+
+
+def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
+                     port: int = 8000,
+                     request_timeout_s: float = 600.0) -> ThreadingHTTPServer:
+    """An HTTP facade over ``server``. POST /generate blocks the client
+    connection until its request completes (the threaded stdlib server
+    gives each connection its own thread; concurrency is the engine's
+    slot pool, not the HTTP layer)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):    # quiet: metrics are the record
+            pass
+
+        def _send(self, code: int, body: dict) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"ok": True})
+            elif self.path == "/stats":
+                self._send(200, server.stats())
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                codes = req.get("codes")
+                if codes is None and "caption" in req:
+                    if server.encode is None:
+                        raise ValueError("server has no vocab; send "
+                                         "'codes', not 'caption'")
+                    codes = server.encode(req["caption"])
+                if not codes:
+                    raise ValueError("need non-empty 'codes' or 'caption'")
+                kwargs = {k: req[k] for k in
+                          ("seed", "temperature", "filter_thres", "top_p",
+                           "priority", "deadline_s") if k in req}
+                handle = server.submit(codes, **kwargs)
+            except S.ServeRejected as e:
+                self._send(429, e.record)
+                return
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": str(e)})
+                return
+            try:
+                result = handle.result(timeout=request_timeout_s)
+            except TimeoutError as e:
+                self._send(504, {"error": str(e)})
+                return
+            self._send(_HTTP_STATUS.get(result.status, 500),
+                       _result_body(result))
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd.daemon_threads = True
+    return httpd
+
+
+def serve_http(server: InferenceServer, host: str = "127.0.0.1",
+               port: int = 8000) -> None:
+    """Blocking HTTP loop (cli/serve.py's main); Ctrl-C shuts down the
+    pipeline cleanly."""
+    httpd = make_http_server(server, host, port)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        server.close()

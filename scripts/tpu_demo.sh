@@ -35,6 +35,12 @@ TOKENS=${TOKENS:-1024}
 CDIM=${CDIM:-256}
 HID=${HID:-64}
 LAYERS=${LAYERS:-3}
+# Backend bring-up discipline for every training invocation: a wedged
+# tunnel claim ends the attempt after this many seconds (with backoff+
+# jitter retries inside the CLI) instead of pending away the healthy
+# window — the r5 failure mode (docs/TPU_OUTAGE_2026-07-30.md, ROADMAP).
+INIT_DEADLINE_S=${INIT_DEADLINE_S:-300}
+INIT_FLAGS="--init_deadline_s $INIT_DEADLINE_S"
 
 # rebuild the dataset whenever the size/count knobs differ from what the
 # existing one was built with (a 32px rehearsal set must not feed a 128px
@@ -96,7 +102,7 @@ else
     --n_epochs "$remaining" --name demovae --num_tokens "$TOKENS" \
     --codebook_dim "$CDIM" --hidden_dim "$HID" --num_layers "$LAYERS" \
     --lr 3e-4 --tempsched --models_dir "$MODELS" --results_dir "$OUT" \
-    --metrics "$OUT/vae_loss.jsonl" --log_interval 10 $resume_flags
+    --metrics "$OUT/vae_loss.jsonl" --log_interval 10 $INIT_FLAGS $resume_flags
 fi
 
 dalle_done=$(latest_epoch demodalle_dalle)
@@ -118,7 +124,7 @@ else
     --dim_head "$((DIM / 8))" --num_text_tokens 64 --text_seq_len 32 \
     --attn_dropout 0.1 --ff_dropout 0.1 --lr 3e-4 --models_dir "$MODELS" \
     --results_dir "$OUT" --metrics "$OUT/dalle_loss.jsonl" \
-    --log_interval 10 --sample_every 8 $resume_flags
+    --log_interval 10 --sample_every 8 $INIT_FLAGS $resume_flags
 fi
 
 echo "== gen_dalle =="
@@ -155,7 +161,7 @@ else
     --dim_head "$((DIM / 8))" --num_text_tokens 64 --text_seq_len 32 \
     --attn_dropout 0.1 --ff_dropout 0.1 --caption_drop 0.1 --lr 3e-4 \
     --models_dir "$MODELS" --results_dir "$OUT" \
-    --metrics "$OUT/cfg_loss.jsonl" --log_interval 10 $resume_flags
+    --metrics "$OUT/cfg_loss.jsonl" --log_interval 10 $INIT_FLAGS $resume_flags
 fi
 
 # A small CLIP on the same captions scores the guidance sweep — mean
@@ -180,7 +186,7 @@ else
     --dim_text "$DIM" --dim_image "$DIM" --dim_latent "$DIM" \
     --num_text_tokens 64 --text_seq_len 32 --lr 3e-4 \
     --models_dir "$MODELS" --results_dir "$OUT" \
-    --metrics "$OUT/clip_loss.jsonl" --log_interval 10 $resume_flags
+    --metrics "$OUT/clip_loss.jsonl" --log_interval 10 $INIT_FLAGS $resume_flags
 fi
 
 echo "== gen_dalle guidance sweep (CLIP-scored) =="

@@ -20,7 +20,11 @@
 set -u
 cd "$(dirname "$0")/.."
 out="docs/TPU_SMOKE_$(date -u +%Y-%m-%d).json"
+# one bring-up deadline for the whole probe: bench.py's backend claim
+# reads this env (resilience.retry discipline, ROADMAP launcher-wiring
+# item) and the sync repro's timeout below derives from the same value
 deadline=${BENCH_INIT_DEADLINE_S:-600}
+export BENCH_INIT_DEADLINE_S="$deadline"
 
 # no pipes here: $? must be the python/timeout status, not tail's
 kernels=$(python bench.py --config kernels 2>/dev/null)

@@ -442,6 +442,51 @@ class TestLRScheduleMath:
         assert float(jnp.abs(u2_clip).min()) > 0.98e-3
         assert float(jnp.abs(u2_plain).max()) < 0.75e-3
 
+    def test_resolve_schedule_snapshot_wins_on_resume(self):
+        """--auto_resume reconstructs the ORIGINAL cosine horizon from the
+        checkpoint's persisted lr_schedule meta: a restart with the
+        remaining epoch count (n_epochs=1) must NOT shrink the decay to
+        the remaining run (ROADMAP open item)."""
+        from dalle_pytorch_tpu.cli.common import resolve_schedule
+        # original run: 4 epochs x 10 steps -> horizon 30 after warmup
+        orig = resolve_schedule(self._args(), steps_per_epoch=10,
+                                start_epoch=0)
+        assert orig["decay_steps"] == 30
+        assert orig["epochs_total"] == 4
+        # restart passes only the REMAINING epochs; the snapshot rides the
+        # checkpoint meta and keeps the original horizon + total
+        resumed = resolve_schedule(self._args(n_epochs=1),
+                                   steps_per_epoch=10, start_epoch=3,
+                                   resume_meta={"lr_schedule": orig})
+        assert resumed["decay_steps"] == 30
+        assert resumed["epochs_total"] == 4
+        # an explicit --decay_steps still wins over the snapshot
+        forced = resolve_schedule(self._args(n_epochs=1, decay_steps=77),
+                                  steps_per_epoch=10, start_epoch=3,
+                                  resume_meta={"lr_schedule": orig})
+        assert forced["decay_steps"] == 77
+
+    def test_make_optimizer_uses_schedule_snapshot(self):
+        """An original run pinned --decay_steps 120; the restart does NOT
+        re-pass it. With the checkpoint's snapshot the optimizer keeps
+        decaying over the original 120-step horizon; without it, the
+        recomputed default horizon (40) has already bottomed out."""
+        from dalle_pytorch_tpu.cli.common import (make_optimizer,
+                                                  resolve_schedule)
+        orig = resolve_schedule(self._args(warmup_steps=0,
+                                           decay_steps=120),
+                                steps_per_epoch=10, start_epoch=0)
+        assert orig["decay_steps"] == 120
+        restart_args = self._args(warmup_steps=0, n_epochs=1)   # no flag
+        snap = resolve_schedule(restart_args, steps_per_epoch=10,
+                                start_epoch=3,
+                                resume_meta={"lr_schedule": orig})
+        with_snap = make_optimizer(restart_args, schedule=snap)
+        without = make_optimizer(restart_args, steps_per_epoch=10,
+                                 start_epoch=3)
+        assert self._lr_at(without, 50) == pytest.approx(1e-4, rel=0.1)
+        assert self._lr_at(with_snap, 50) > 2e-4
+
     def test_resume_with_toggled_clip_fails_clearly(self):
         """Toggling --clip_grad_norm on resume changes the opt-state tree;
         restore must say which flags to check, not raise a raw flax

@@ -204,3 +204,108 @@ def jax_flat(tree):
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         out[jax.tree_util.keystr(path)] = np.asarray(leaf)
     return out
+
+
+# ---------------------------------------------------------------------------
+# loss-level NaN injection: the hook that reaches train_dalle/train_clip,
+# whose integer-token batches have no float leaves for corrupt_batch
+# (ROADMAP open item; faults.corrupt_loss via TrainSupervisor.check_step)
+# ---------------------------------------------------------------------------
+
+def make_caption_dataset(root):
+    """8 images + caption files, the train_dalle/train_clip data
+    contract, at the same minimal scale as make_dataset."""
+    make_dataset(root)
+    names = [f"img{i}.png" for i in range(8)]
+    colors = ["red", "blue", "green", "gray"]
+    (root / "only.txt").write_text(
+        "".join(f"a {colors[i % 4]} square\n" for i in range(8)))
+    (root / "pairs.txt").write_text(
+        "".join(f"{n} : a {colors[i % 4]} square\n"
+                for i, n in enumerate(names)))
+
+
+def caption_args(root, extra=()):
+    # 8 pairs / batch 4 -> 2 steps per epoch (same cadence as vae_args)
+    return [
+        "--dataPath", str(root / "imagedata"),
+        "--imageSize", str(IMG), "--batchSize", "4",
+        "--captions_only", str(root / "only.txt"),
+        "--captions", str(root / "pairs.txt"),
+        "--num_text_tokens", "20", "--text_seq_len", "4",
+        "--lr", "1e-3",
+        "--models_dir", str(root / "models"),
+        "--results_dir", str(root / "results"),
+        "--metrics", str(root / "metrics.jsonl"),
+        "--log_interval", "1", "--dp", "1",
+    ] + list(extra)
+
+
+def assert_rolled_back_and_finished(root, name, epochs=2):
+    recs = read_metrics(root)
+    rollbacks = [r for r in recs if r.get("kind") == "rollback"]
+    assert len(rollbacks) == 1
+    assert rollbacks[0]["step"] == 1
+    assert "non-finite" in rollbacks[0]["reason"]
+    trained = {r["step"]: r["loss"] for r in recs
+               if "loss" in r and "step" in r and "kind" not in r}
+    assert 1 not in trained               # the poisoned step never counts
+    assert all(math.isfinite(v) for v in trained.values())
+    path, epoch = ckpt.latest(str(root / "models"), name)
+    assert epoch == epochs - 1
+    params, manifest = ckpt.restore_params(path)
+    for k, v in jax_flat(params).items():
+        assert np.isfinite(v).all(), k
+    assert math.isfinite(manifest["meta"]["avg_loss"])
+
+
+class TestNaNLossInjection:
+    def test_corrupt_loss_fires_exactly_once(self):
+        with faults.injected(nan_loss_at_step=3):
+            assert faults.corrupt_loss(1.0, 2) == 1.0
+            assert math.isnan(faults.corrupt_loss(1.0, 3))
+            assert faults.corrupt_loss(1.0, 3) == 1.0   # one-shot
+        assert faults.corrupt_loss(1.0, 3) == 1.0       # no active plan
+
+    def test_integer_batch_corrupt_batch_still_fails_loudly(self):
+        """corrupt_batch on a float-free batch keeps raising (the guard
+        that motivated the loss-level hook)."""
+        with faults.injected(nan_at_step=0):
+            with pytest.raises(faults.FaultInjected,
+                               match="nan_loss_at_step"):
+                faults.corrupt_batch({"text": np.zeros((2, 4), np.int32)},
+                                     0)
+
+    def test_nan_loss_rolls_back_train_dalle(self, tmp_path):
+        """The full rollback loop on the DALLE CLI: a good cadence
+        checkpoint at step 0, a NaN loss reported at step 1, training
+        restores the anchor and finishes both epochs finite."""
+        from dalle_pytorch_tpu.cli.train_dalle import main as dalle_main
+        from dalle_pytorch_tpu.cli.train_vae import main as vae_main
+        root = tmp_path
+        make_caption_dataset(root)
+        vae_main(vae_args(root, ["--n_epochs", "1", "--num_tokens", "8",
+                                 "--codebook_dim", "16"]))
+        os.remove(root / "metrics.jsonl")    # keep only the DALLE records
+        with faults.injected(nan_loss_at_step=1):
+            dalle_main(caption_args(root, [
+                "--vaename", "vae", "--vae_epoch", "0", "--name", "toy",
+                "--n_epochs", "2", "--dim", "16", "--depth", "1",
+                "--heads", "2", "--dim_head", "8", "--attn_dropout", "0",
+                "--ff_dropout", "0", "--sample_every", "0",
+                "--save_every", "1"]))
+        assert_rolled_back_and_finished(root, "toy_dalle")
+
+    def test_nan_loss_rolls_back_train_clip(self, tmp_path):
+        from dalle_pytorch_tpu.cli.train_clip import main as clip_main
+        root = tmp_path
+        make_caption_dataset(root)
+        with faults.injected(nan_loss_at_step=1):
+            clip_main(caption_args(root, [
+                "--name", "clip", "--n_epochs", "2",
+                "--dim_text", "16", "--dim_image", "16",
+                "--dim_latent", "16", "--text_enc_depth", "1",
+                "--text_heads", "2", "--visual_enc_depth", "1",
+                "--visual_heads", "2", "--visual_patch_size", "4",
+                "--dense", "--save_every", "1"]))
+        assert_rolled_back_and_finished(root, "clip")

@@ -1,0 +1,334 @@
+"""Serving subsystem tests (ISSUE 2 acceptance criteria).
+
+The load-bearing one is equivalence: for the same params/prompt/seed/
+sampling knobs, the slot-batched engine's emitted image tokens are
+IDENTICAL to ``models.dalle.generate_images`` at batch 1 — including
+requests that join mid-stream while other slots are mid-decode, different
+prompt lengths, per-request temperature/top-k/top-p. Plus the structured-
+backpressure contract (queue-full and deadline-exceeded are typed results,
+no hangs, no silent drops) and the one-compile contract (the decode
+program traces exactly once across a multi-request run).
+
+All CPU, tiny model (total_len 24) so the whole file stays cheap inside
+tier-1.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.models import dalle as D
+from dalle_pytorch_tpu.models import vae as V
+from dalle_pytorch_tpu.serve import (DEADLINE_EXCEEDED, OK, QueueFull,
+                                     Request, RequestQueue, SamplingParams)
+from dalle_pytorch_tpu.serve.engine import Engine
+
+VCFG = V.VAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                   num_layers=2, hidden_dim=8)
+CFG = D.DALLEConfig(dim=16, depth=2, vae=VCFG, num_text_tokens=50,
+                    text_seq_len=8, heads=2, dim_head=8)
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    key = jax.random.PRNGKey(0)
+    vae_params = V.vae_init(jax.random.fold_in(key, 1), VCFG)
+    params = D.dalle_init(key, CFG, vae_params)
+    return params, vae_params
+
+
+def reference_tokens(params, vae_params, req: Request) -> np.ndarray:
+    """generate_images at batch 1 — the one-shot path the engine must
+    reproduce token-for-token."""
+    text = jnp.asarray([req.codes], jnp.int32)
+    _, img_seq = D.generate_images(
+        params, vae_params, text, cfg=CFG,
+        rng=jax.random.PRNGKey(req.seed),
+        filter_thres=req.sampling.filter_thres,
+        top_p=req.sampling.top_p,
+        temperature=req.sampling.temperature, return_img_seq=True)
+    return np.asarray(img_seq)[0]
+
+
+REQS = [
+    Request(codes=(3, 7, 9), seed=11),
+    Request(codes=(5, 2, 8, 1, 4), seed=23,
+            sampling=SamplingParams(temperature=0.7, filter_thres=0.8)),
+    Request(codes=(6, 6), seed=5,
+            sampling=SamplingParams(temperature=1.3, top_p=0.9)),
+]
+
+
+class TestEquivalence:
+    def test_tokens_identical_to_generate_images(self, bundle):
+        """3 requests (different prompt lengths / temperatures / top-k /
+        top-p) through a 2-slot pool: more requests than slots, so slots
+        are reused (leave + join) — every emitted image-token sequence
+        must equal the one-shot sampler's, and the decode program must
+        have compiled exactly once."""
+        params, vae_params = bundle
+        refs = [reference_tokens(params, vae_params, r) for r in REQS]
+
+        queue = RequestQueue(max_depth=8)
+        engine = Engine(params, CFG, queue, num_slots=2)
+        handles = [queue.submit(r) for r in REQS]
+        engine.run_until_idle()
+
+        for h, ref in zip(handles, refs):
+            res = h.result(timeout=5)
+            assert res.status == OK
+            np.testing.assert_array_equal(np.asarray(res.tokens), ref)
+            assert res.total_s > 0 and res.decode_s > 0
+        assert engine.decode_traces == 1, \
+            "fixed-shape decode must compile exactly once"
+        # prefill compiles per distinct (prompt_len, group_size), never
+        # per request
+        assert engine.prefill_traces <= len({len(r.codes) for r in REQS})
+
+    def test_join_midstream_does_not_perturb_running_slot(self, bundle):
+        """A request admitted while another slot is mid-decode (the
+        continuous-batching join) must not change either slot's tokens."""
+        params, vae_params = bundle
+        r_a, r_b = REQS[0], REQS[1]
+        ref_a = reference_tokens(params, vae_params, r_a)
+        ref_b = reference_tokens(params, vae_params, r_b)
+
+        queue = RequestQueue(max_depth=8)
+        engine = Engine(params, CFG, queue, num_slots=2)
+        h_a = queue.submit(r_a)
+        for _ in range(5):                  # a is 5 tokens into decode
+            engine.step_once()
+        assert engine.active_slots() == 1
+        h_b = queue.submit(r_b)             # b joins mid-stream
+        engine.run_until_idle()
+
+        np.testing.assert_array_equal(
+            np.asarray(h_a.result(timeout=5).tokens), ref_a)
+        np.testing.assert_array_equal(
+            np.asarray(h_b.result(timeout=5).tokens), ref_b)
+        assert engine.decode_traces == 1
+
+    def test_int8_kv_slot_cache_runs(self, bundle):
+        """quantize_cache composes with the slot pool: the engine matches
+        generate_images(quantize_cache=True) token-for-token (both sides
+        quantize rows the same way, ops.decode._store_rows)."""
+        params, vae_params = bundle
+        req = REQS[0]
+        text = jnp.asarray([req.codes], jnp.int32)
+        _, ref = D.generate_images(
+            params, vae_params, text, cfg=CFG,
+            rng=jax.random.PRNGKey(req.seed), return_img_seq=True,
+            quantize_cache=True)
+        queue = RequestQueue(max_depth=4)
+        engine = Engine(params, CFG, queue, num_slots=2,
+                        quantize_cache=True)
+        h = queue.submit(req)
+        engine.run_until_idle()
+        np.testing.assert_array_equal(np.asarray(h.result(5).tokens),
+                                      np.asarray(ref)[0])
+
+
+class TestBackpressure:
+    def test_queue_full_is_typed_and_structured(self, bundle):
+        params, _ = bundle
+        events = []
+        queue = RequestQueue(max_depth=2, on_event=events.append)
+        for i in range(2):
+            queue.submit(Request(codes=(1, 2), seed=i))
+        with pytest.raises(QueueFull) as ei:
+            queue.submit(Request(codes=(1, 2), seed=9))
+        rec = ei.value.record
+        assert rec["kind"] == "serve_reject"
+        assert rec["reason"] == "queue_full"
+        assert rec["queue_depth"] == 2
+        assert events and events[0]["kind"] == "serve_reject"
+        assert queue.rejected == 1
+
+    def test_deadline_expired_in_queue(self, bundle):
+        """A request whose deadline passes while queued completes as a
+        typed deadline_exceeded result without ever taking a slot."""
+        params, _ = bundle
+        queue = RequestQueue(max_depth=4)
+        engine = Engine(params, CFG, queue, num_slots=1)
+        h = queue.submit(Request(codes=(1, 2), seed=0, deadline_s=0.0))
+        time.sleep(0.01)
+        engine.run_until_idle()
+        res = h.result(timeout=5)
+        assert res.status == DEADLINE_EXCEEDED
+        assert "queued" in res.reason
+        assert engine.decode_steps == 0     # never spent a slot on it
+
+    def test_deadline_expired_mid_decode(self, bundle):
+        """A deadline that passes while the request is decoding cancels
+        the slot with a typed result; other slots keep their exact token
+        streams."""
+        params, vae_params = bundle
+        ref = reference_tokens(params, vae_params, REQS[0])
+        queue = RequestQueue(max_depth=4)
+        engine = Engine(params, CFG, queue, num_slots=2)
+        h_ok = queue.submit(REQS[0])
+        h_dead = queue.submit(Request(codes=(2, 2), seed=1,
+                                      deadline_s=0.005))
+        engine.step_once()                  # both admitted, one step in
+        time.sleep(0.02)                    # deadline passes mid-decode
+        engine.run_until_idle()
+        res = h_dead.result(timeout=5)
+        assert res.status == DEADLINE_EXCEEDED
+        assert "decoding" in res.reason
+        np.testing.assert_array_equal(
+            np.asarray(h_ok.result(timeout=5).tokens), ref)
+
+    def test_expired_reaped_even_with_full_pool(self, bundle):
+        """A dead queued entry must get its typed result (and stop
+        holding queue capacity) even while every slot is busy — reaping
+        is not gated on free slots."""
+        params, _ = bundle
+        queue = RequestQueue(max_depth=2)
+        engine = Engine(params, CFG, queue, num_slots=1)
+        queue.submit(Request(codes=(1, 1), seed=0))
+        engine.step_once()                  # pool now full
+        h_dead = queue.submit(Request(codes=(2, 2), seed=1,
+                                      deadline_s=0.0))
+        time.sleep(0.01)
+        engine.step_once()                  # free == 0, still reaps
+        res = h_dead.result(timeout=1)
+        assert res.status == DEADLINE_EXCEEDED
+        assert queue.depth() == 0           # capacity released
+
+    def test_cancel_active_fulfills_inflight_slots(self, bundle):
+        """Shutdown covers requests already admitted to slots, not just
+        queued ones (the no-hangs contract through close())."""
+        from dalle_pytorch_tpu.serve import CANCELLED
+        params, _ = bundle
+        queue = RequestQueue(max_depth=4)
+        engine = Engine(params, CFG, queue, num_slots=2)
+        h = queue.submit(Request(codes=(1, 2), seed=0))
+        engine.step_once()                  # admitted, mid-decode
+        assert engine.active_slots() == 1
+        assert engine.cancel_active() == 1
+        assert h.result(timeout=1).status == CANCELLED
+        assert engine.active_slots() == 0
+
+    def test_priority_orders_admission(self, bundle):
+        """With one slot busy, a later high-priority (lower value) submit
+        is admitted before an earlier low-priority one."""
+        params, _ = bundle
+        queue = RequestQueue(max_depth=8)
+        engine = Engine(params, CFG, queue, num_slots=1)
+        running = queue.submit(Request(codes=(1, 1), seed=0))
+        engine.step_once()                  # occupies the only slot
+        low = queue.submit(Request(codes=(2, 2), seed=1, priority=5))
+        high = queue.submit(Request(codes=(3, 3), seed=2, priority=0))
+        order = []
+        done = set()
+        while len(done) < 3:
+            engine.step_once()
+            for name, h in (("running", running), ("low", low),
+                            ("high", high)):
+                if name not in done and h.done():
+                    done.add(name)
+                    order.append(name)
+        assert order == ["running", "high", "low"]
+
+
+class TestBurstOccupancy:
+    def test_burst_fills_slots_and_decodes_concurrently(self, bundle):
+        """A burst larger than the pool keeps every slot busy — the
+        continuous-batching win over one-at-a-time gen_dalle."""
+        params, _ = bundle
+        queue = RequestQueue(max_depth=8)
+        engine = Engine(params, CFG, queue, num_slots=3)
+        handles = [queue.submit(Request(codes=(1 + i, 2), seed=i))
+                   for i in range(6)]
+        engine.step_once()
+        assert engine.active_slots() == 3   # full pool from the burst
+        engine.run_until_idle()
+        assert all(h.result(5).status == OK for h in handles)
+        stats = engine.stats()
+        assert stats["mean_occupancy"] > 1.5
+        assert stats["decode_compiles"] == 1
+        assert stats["completed"] == 6
+
+
+class TestServerPipeline:
+    def test_server_decodes_images_and_matches_one_shot(self, bundle):
+        """The full pipeline (queue -> engine thread -> postprocess
+        thread): the returned image equals generate_images' decoded
+        pixels for the same request."""
+        params, vae_params = bundle
+        from dalle_pytorch_tpu.serve.server import InferenceServer
+        req = REQS[0]
+        text = jnp.asarray([req.codes], jnp.int32)
+        ref_img = np.asarray(D.generate_images(
+            params, vae_params, text, cfg=CFG,
+            rng=jax.random.PRNGKey(req.seed)))[0]
+
+        server = InferenceServer(params, vae_params, CFG, num_slots=2,
+                                 queue_depth=8).start()
+        try:
+            res = server.generate(req.codes, seed=req.seed, timeout=60)
+            assert res.status == OK
+            np.testing.assert_allclose(res.image, ref_img, rtol=1e-5,
+                                       atol=1e-5)
+            stats = server.stats()
+            assert stats["completed"] == 1
+            assert stats["p50_latency_s"] > 0
+        finally:
+            server.close()
+
+    def test_server_close_cancels_queued(self, bundle):
+        params, vae_params = bundle
+        from dalle_pytorch_tpu.serve import CANCELLED
+        from dalle_pytorch_tpu.serve.server import InferenceServer
+        server = InferenceServer(params, vae_params, CFG, num_slots=1,
+                                 queue_depth=8, decode_images=False)
+        # never started: everything queued is cancelled with a typed
+        # result at close
+        h = server.submit((1, 2), seed=0)
+        server.close()
+        assert h.result(timeout=5).status == CANCELLED
+
+    def test_http_generate_and_stats(self, bundle):
+        """The stdlib HTTP facade end-to-end on a loopback port."""
+        import json
+        import urllib.request
+        params, vae_params = bundle
+        from dalle_pytorch_tpu.serve.server import (InferenceServer,
+                                                    make_http_server)
+        server = InferenceServer(params, vae_params, CFG, num_slots=2,
+                                 queue_depth=8,
+                                 decode_images=False).start()
+        httpd = make_http_server(server, "127.0.0.1", 0)   # ephemeral port
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            body = json.dumps({"codes": [3, 7, 9], "seed": 11}).encode()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/generate", data=body,
+                    timeout=60) as resp:
+                out = json.loads(resp.read())
+            assert out["status"] == "ok"
+            ref = reference_tokens(params, vae_params, REQS[0])
+            assert out["tokens"] == [int(t) for t in ref]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/stats", timeout=10) as resp:
+                stats = json.loads(resp.read())
+            assert stats["completed"] == 1
+            assert stats["decode_compiles"] == 1
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            server.close()
+
+
+class TestSamplingValidation:
+    def test_bad_sampling_params_raise_at_construction(self):
+        with pytest.raises(ValueError, match="temperature"):
+            SamplingParams(temperature=0.0)
+        with pytest.raises(ValueError, match="top_p"):
+            SamplingParams(top_p=1.5)
