@@ -6,8 +6,9 @@ types (Request/Result/QueueFull) are importable before a backend exists —
 the same discipline as ``resilience`` (utils/metrics.py note)."""
 
 from dalle_pytorch_tpu.serve.scheduler import (  # noqa: F401
-    CANCELLED, DEADLINE_EXCEEDED, ERROR, OK, REJECTED, QueueFull, Request,
-    RequestHandle, RequestQueue, Result, SamplingParams, ServeRejected)
+    CANCELLED, DEADLINE_EXCEEDED, ERROR, OK, REJECTED, InvalidRequest,
+    QueueClosed, QueueFull, Request, RequestHandle, RequestQueue, Result,
+    SamplingParams, ServeRejected)
 
 
 def __getattr__(name):

@@ -250,6 +250,17 @@ class Engine:
             queued_s=round(now - req.submit_t, 6),
             total_s=round(now - req.submit_t, 6)))
 
+    def _error(self, handle: S.RequestHandle, now: float,
+               reason: str) -> None:
+        req = handle.request
+        if self.metrics is not None:
+            self.metrics.event(**S.structured_event(
+                "serve_error", request_id=req.request_id, error=reason))
+        self._finish(handle, S.Result(
+            status=S.ERROR, request_id=req.request_id, reason=reason,
+            queued_s=round(now - req.submit_t, 6),
+            total_s=round(now - req.submit_t, 6)))
+
     def _admit(self, handles: List[S.RequestHandle], now: float) -> None:
         import jax
         import jax.numpy as jnp
@@ -257,7 +268,15 @@ class Engine:
         assert len(handles) <= len(free)
         groups = defaultdict(list)
         for h in handles:
-            groups[len(h.request.codes)].append(h)
+            # the server's queue validates at submit; a raw queue may
+            # not — a prompt the pool can't hold must become a typed
+            # error result, never a crash of the serving loop
+            n = len(h.request.codes)
+            if not 1 <= n <= self.cfg.text_seq_len:
+                self._error(h, now, f"invalid prompt length {n} "
+                            f"(need 1..{self.cfg.text_seq_len})")
+                continue
+            groups[n].append(h)
         for t0, group in groups.items():
             idx = free[:len(group)]
             free = free[len(group):]
@@ -272,11 +291,20 @@ class Engine:
                 self.topk_k[i] = max(
                     int((1 - req.sampling.filter_thres) * v), 1)
                 self.top_p[i] = np.float32(req.sampling.top_p)
-            first, self.cache = self._prefill_fn(t0, len(group))(
-                self.params, self.cache, jnp.asarray(text),
-                jnp.asarray(slots), jnp.asarray(self.rng[idx]),
-                jnp.asarray(self.temp[idx]), jnp.asarray(self.topk_k[idx]),
-                jnp.asarray(self.top_p[idx]))
+            try:
+                first, self.cache = self._prefill_fn(t0, len(group))(
+                    self.params, self.cache, jnp.asarray(text),
+                    jnp.asarray(slots), jnp.asarray(self.rng[idx]),
+                    jnp.asarray(self.temp[idx]),
+                    jnp.asarray(self.topk_k[idx]),
+                    jnp.asarray(self.top_p[idx]))
+            except Exception as e:  # noqa: BLE001 — no-hangs contract
+                # the group's slots were never assigned (still None), so
+                # the pool stays consistent; the group's callers get a
+                # typed error instead of hanging on a dead loop
+                for h in group:
+                    self._error(h, now, f"prefill failed: {e!r}")
+                continue
             first = np.asarray(first)
             for j, (i, h) in enumerate(zip(idx, group)):
                 self.pos[i] = t0
@@ -291,9 +319,14 @@ class Engine:
             req = slot.handle.request
             full = list(req.codes) + slot.emitted
             img_seq = np.asarray(full[-self.cfg.image_seq_len:], np.int32)
+            # the completed text span (prompt + sampled text tokens) —
+            # generate_images' full[:, :text_seq_len], what CLIP rerank
+            # scores (postprocess.py)
+            text_seq = np.asarray(full[:self.cfg.text_seq_len], np.int32)
             self.completed += 1
             self._finish(slot.handle, S.Result(
                 status=S.OK, request_id=req.request_id, tokens=img_seq,
+                text_tokens=text_seq,
                 queued_s=round(slot.t_admit - req.submit_t, 6),
                 decode_s=round(now - slot.t_admit, 6),
                 total_s=round(now - req.submit_t, 6)))
@@ -380,32 +413,66 @@ class Engine:
 
     def run(self, stop: threading.Event, idle_sleep_s: float = 0.002):
         """Serving loop for a dedicated thread (serve.server): spin while
-        there is work, nap briefly when idle."""
+        there is work, nap briefly when idle. An exception out of
+        ``step_once`` must NOT kill the loop — one bad step would leave
+        every queued and future request hanging forever while /healthz
+        still answers. Instead the implicated in-slot requests are
+        fulfilled with typed ``error`` results, the pool is reset to a
+        consistent idle state, and serving continues."""
         while not stop.is_set():
-            if not self.step_once() and self.queue.depth() == 0 \
+            try:
+                busy = self.step_once()
+            except Exception as e:  # noqa: BLE001 — no-hangs contract
+                # recovery FIRST, observability second: a raising
+                # metrics sink must not kill the thread before the
+                # in-slot handles are fulfilled
+                n = self.fail_active(f"engine step failed: {e!r}")
+                if self.metrics is not None:
+                    try:
+                        self.metrics.event(**S.structured_event(
+                            "serve_engine_error", error=repr(e),
+                            failed=n))
+                    except Exception:   # noqa: BLE001
+                        pass
+                stop.wait(idle_sleep_s)     # never hot-spin on a
+                continue                    # persistent fault
+            if not busy and self.queue.depth() == 0 \
                     and self.active_slots() == 0:
                 stop.wait(idle_sleep_s)
 
-    def cancel_active(self, reason: str = "server shutdown") -> int:
-        """Fulfil every in-slot request with a typed ``cancelled`` result
-        and free the slots (the shutdown path — the no-hangs contract
-        must cover requests already admitted, not just queued ones).
-        Returns the number cancelled."""
+    def _terminate_active(self, status: str, reason: str) -> int:
+        """Fulfil every in-slot request with a typed terminal result and
+        reset the pool to idle (slot state may be mid-update on the error
+        path, so the only consistent continuation is an empty pool).
+        Returns the number terminated."""
         n = 0
         with self._lock:
+            now = self.clock()
             for i, slot in enumerate(self.slots):
                 if slot is None:
                     continue
                 req = slot.handle.request
                 slot.handle.fulfill(S.Result(
-                    status=S.CANCELLED, request_id=req.request_id,
+                    status=status, request_id=req.request_id,
                     reason=reason,
-                    queued_s=round(slot.t_admit - req.submit_t, 6)))
+                    queued_s=round(slot.t_admit - req.submit_t, 6),
+                    total_s=round(now - req.submit_t, 6)))
                 self.slots[i] = None
-                self.pos[i] = 0
-                self.cur_tok[i] = 0
                 n += 1
+            self.pos[:] = 0
+            self.cur_tok[:] = 0
         return n
+
+    def fail_active(self, reason: str) -> int:
+        """Typed ``error`` results for every in-slot request — the
+        run-loop's recovery path after an unexpected step failure."""
+        return self._terminate_active(S.ERROR, reason)
+
+    def cancel_active(self, reason: str = "server shutdown") -> int:
+        """Typed ``cancelled`` results for every in-slot request — the
+        shutdown path (the no-hangs contract must cover requests already
+        admitted, not just queued ones)."""
+        return self._terminate_active(S.CANCELLED, reason)
 
     # -- observability ------------------------------------------------------
 

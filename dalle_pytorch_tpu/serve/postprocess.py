@@ -35,7 +35,8 @@ class PostProcessor:
 
     def __init__(self, params: dict, vae_params: dict, cfg, *,
                  clip_params: Optional[dict] = None, clip_cfg=None,
-                 metrics=None, max_pending: int = 64):
+                 metrics=None, max_pending: int = 64,
+                 on_fulfill=None):
         import jax
 
         from dalle_pytorch_tpu.models import vae as vae_mod
@@ -46,6 +47,12 @@ class PostProcessor:
         self.clip_params = clip_params
         self.clip_cfg = clip_cfg
         self.metrics = metrics
+        # called with the final Result just before handle.fulfill — the
+        # server records its p50/p95 latency here so percentiles include
+        # the VAE/CLIP time the caller actually waited for (before the
+        # fulfill, so a caller woken by result() never reads stats that
+        # predate its own completion)
+        self.on_fulfill = on_fulfill
         self.decoded = 0
 
         # bounded: a stalled consumer backpressures the engine thread at
@@ -91,6 +98,14 @@ class PostProcessor:
     def pending(self) -> int:
         return self._q.qsize()
 
+    def _fulfill(self, handle: S.RequestHandle, result: S.Result) -> None:
+        if self.on_fulfill is not None:
+            try:
+                self.on_fulfill(result)
+            except Exception:   # noqa: BLE001 — a stats hook must never
+                pass            # block the handle from being fulfilled
+        handle.fulfill(result)
+
     # -- worker -------------------------------------------------------------
 
     def _work(self) -> None:
@@ -107,23 +122,37 @@ class PostProcessor:
                                      self.params["image_emb"]["w"], img_seq)
                 result.image = np.asarray(image)[0]
                 if self._score is not None:
-                    req = handle.request
-                    text = np.zeros((1, self.clip_cfg.text_seq_len),
-                                    np.int32)
-                    codes = list(req.codes)[:self.clip_cfg.text_seq_len]
-                    text[0, :len(codes)] = codes
+                    # score the COMPLETED text span the engine harvested
+                    # (prompt + model-sampled text tokens) — exactly the
+                    # full[:, :text_seq_len] row generate_images' rerank
+                    # scores, so short prompts score identically to the
+                    # one-shot path. Raw codes are the fallback for
+                    # results built without an engine.
+                    if result.text_tokens is not None:
+                        text = np.asarray(result.text_tokens,
+                                          np.int32)[None]
+                    else:
+                        req = handle.request
+                        text = np.zeros((1, self.clip_cfg.text_seq_len),
+                                        np.int32)
+                        codes = list(req.codes)[:self.clip_cfg.text_seq_len]
+                        text[0, :len(codes)] = codes
                     score = self._score(self.clip_params,
                                         jnp.asarray(text), image)
                     result.clip_score = float(np.asarray(score)[0])
                 self.decoded += 1
                 result.total_s = round(
                     result.total_s + (time.monotonic() - t0), 6)
-                handle.fulfill(result)
+                self._fulfill(handle, result)
             except Exception as e:      # noqa: BLE001 — no-hangs contract
-                handle.fulfill(S.Result(
+                result = S.Result(
                     status=S.ERROR, request_id=result.request_id,
-                    tokens=result.tokens, reason=f"postprocess: {e}"))
+                    tokens=result.tokens, reason=f"postprocess: {e}",
+                    queued_s=result.queued_s, decode_s=result.decode_s,
+                    total_s=round(result.total_s
+                                  + (time.monotonic() - t0), 6))
+                self._fulfill(handle, result)
                 if self.metrics is not None:
                     self.metrics.event(**S.structured_event(
                         "serve_postprocess_error",
-                        request_id=result.request_id, error=str(e)))
+                        request_id=result.request_id, error=result.reason))

@@ -52,6 +52,17 @@ class QueueFull(ServeRejected):
     of letting latency grow without bound."""
 
 
+class InvalidRequest(ServeRejected):
+    """The request can never run (empty prompt, or prompt longer than the
+    model's text span) — rejected at submit so a malformed request cannot
+    reach the engine, let alone take down its decode loop."""
+
+
+class QueueClosed(ServeRejected):
+    """The server is shutting down; a submit racing ``close()`` gets this
+    typed reject instead of landing in a queue nobody will ever drain."""
+
+
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Per-request sampling knobs — the same surface ``generate_images``
@@ -91,11 +102,15 @@ class Request:
 class Result:
     """Terminal state of a request. ``tokens`` is the sampled image-token
     sequence (image ids, no text offset — ``generate_images``'s
-    ``img_seq``); ``image`` is filled by the postprocess stage when image
-    decoding is enabled."""
+    ``img_seq``); ``text_tokens`` is the COMPLETED text span (the prompt
+    plus the model-sampled text tokens filling it out to ``text_seq_len``
+    — ``generate_images``'s ``full[:, :text_seq_len]``), what CLIP
+    rerank scores; ``image`` is filled by the postprocess stage when
+    image decoding is enabled."""
     status: str
     request_id: int
     tokens: object = None
+    text_tokens: object = None
     image: object = None
     clip_score: Optional[float] = None
     reason: str = ""
@@ -137,20 +152,26 @@ class RequestQueue:
     """Bounded, thread-safe priority queue.
 
     ``submit`` raises ``QueueFull`` past ``max_depth`` (the structured
-    reject); ``pop_ready`` hands the engine up to ``n`` admissible
+    reject), ``InvalidRequest`` for a prompt the engine could never run
+    (empty, or longer than ``max_prompt_len`` when one is set — the
+    server sets it to ``cfg.text_seq_len``), and ``QueueClosed`` after
+    ``close()``; ``pop_ready`` hands the engine up to ``n`` admissible
     requests in (priority, arrival) order, separating out entries whose
     deadline already passed so the engine can fulfil them as
     ``deadline_exceeded`` without spending a slot."""
 
     def __init__(self, max_depth: int = 64,
+                 max_prompt_len: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic,
                  on_event=None):
         self.max_depth = int(max_depth)
+        self.max_prompt_len = max_prompt_len
         self.clock = clock
         self.on_event = on_event
         self._heap: list = []
         self._seq = itertools.count()
         self._lock = threading.Lock()
+        self._closed = False
         self.submitted = 0
         self.rejected = 0
 
@@ -158,18 +179,40 @@ class RequestQueue:
         with self._lock:
             return len(self._heap)
 
+    def close(self) -> None:
+        """Gate further ``submit``s (typed ``QueueClosed``). Set BEFORE
+        the shutdown drain so a submit racing ``close()`` cannot land in
+        the queue after the drain and leave its caller blocked."""
+        with self._lock:
+            self._closed = True
+
+    def _reject(self, exc_type, **fields):
+        self.rejected += 1
+        record = structured_event("serve_reject", **fields)
+        if self.on_event is not None:
+            self.on_event(record)
+        raise exc_type(record)
+
     def submit(self, request: Request) -> RequestHandle:
         now = self.clock()
         with self._lock:
+            if self._closed:
+                self._reject(QueueClosed, reason="queue_closed",
+                             queue_depth=len(self._heap),
+                             priority=request.priority)
+            n_codes = len(request.codes)
+            if n_codes == 0 or (self.max_prompt_len is not None
+                                and n_codes > self.max_prompt_len):
+                self._reject(InvalidRequest, reason="invalid_prompt",
+                             prompt_len=n_codes,
+                             max_prompt_len=self.max_prompt_len,
+                             queue_depth=len(self._heap),
+                             priority=request.priority)
             if len(self._heap) >= self.max_depth:
-                self.rejected += 1
-                record = structured_event(
-                    "serve_reject", reason="queue_full",
-                    queue_depth=len(self._heap),
-                    max_depth=self.max_depth, priority=request.priority)
-                if self.on_event is not None:
-                    self.on_event(record)
-                raise QueueFull(record)
+                self._reject(QueueFull, reason="queue_full",
+                             queue_depth=len(self._heap),
+                             max_depth=self.max_depth,
+                             priority=request.priority)
             rid = self.submitted
             self.submitted += 1
             request = dataclasses.replace(request, request_id=rid,

@@ -59,13 +59,17 @@ class InferenceServer:
 
         self.queue = S.RequestQueue(
             max_depth=queue_depth,
+            # a prompt the slot pool can't hold is rejected HERE (typed
+            # InvalidRequest / HTTP 400), before it can reach the engine
+            max_prompt_len=cfg.text_seq_len,
             on_event=(lambda rec: metrics.event(**rec))
             if metrics is not None else None)
         self.post = None
         if decode_images:
             self.post = post_mod.PostProcessor(
                 params, vae_params, cfg, clip_params=clip_params,
-                clip_cfg=clip_cfg, metrics=metrics)
+                clip_cfg=clip_cfg, metrics=metrics,
+                on_fulfill=self._record_latency)
         self.engine = engine_mod.Engine(
             params, cfg, self.queue, num_slots=num_slots,
             complete=self._on_decoded, metrics=metrics,
@@ -81,13 +85,24 @@ class InferenceServer:
 
     # -- stage glue ---------------------------------------------------------
 
-    def _on_decoded(self, handle: S.RequestHandle,
-                    result: S.Result) -> None:
+    def _record_latency(self, result: S.Result) -> None:
+        # successful completions only: mixing in error results (whose
+        # wait ends early) would deflate the percentiles exactly when a
+        # failing dependency makes the tail matter most
+        if not result.ok:
+            return
         with self._lat_lock:
             self._latencies.append(result.total_s)
+
+    def _on_decoded(self, handle: S.RequestHandle,
+                    result: S.Result) -> None:
         if self.post is not None:
+            # latency is recorded by the postprocess stage's on_fulfill,
+            # AFTER VAE/CLIP time lands in total_s — the percentiles must
+            # describe what the caller actually waited for
             self.post.submit(handle, result)
         else:
+            self._record_latency(result)
             handle.fulfill(result)
 
     # -- lifecycle ----------------------------------------------------------
@@ -124,10 +139,13 @@ class InferenceServer:
         return self
 
     def close(self, timeout: float = 30.0) -> None:
-        """Stop the engine, then cancel everything still queued AND
-        everything mid-decode in a slot (typed results — the no-hangs
-        contract holds through shutdown for admitted requests too), then
-        drain the postprocess stage."""
+        """Close the queue (a submit racing shutdown gets a typed
+        ``QueueClosed`` instead of landing after the drain and hanging
+        its caller), stop the engine, then cancel everything still
+        queued AND everything mid-decode in a slot (typed results — the
+        no-hangs contract holds through shutdown for admitted requests
+        too), then drain the postprocess stage."""
+        self.queue.close()
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout)
@@ -148,8 +166,10 @@ class InferenceServer:
                filter_thres: float = 0.5, top_p: float = 0.0,
                priority: int = 0,
                deadline_s: Optional[float] = None) -> S.RequestHandle:
-        """Enqueue one generation request. Raises ``scheduler.QueueFull``
-        (typed, structured) on backpressure."""
+        """Enqueue one generation request. Raises a typed, structured
+        ``scheduler.ServeRejected`` subclass: ``QueueFull`` on
+        backpressure, ``InvalidRequest`` for an empty or over-long
+        prompt, ``QueueClosed`` after ``close()``."""
         return self.queue.submit(S.Request(
             codes=tuple(int(c) for c in codes), seed=seed,
             sampling=S.SamplingParams(temperature=temperature,
@@ -161,6 +181,10 @@ class InferenceServer:
                  **kwargs) -> S.Result:
         """Synchronous convenience: submit + wait."""
         return self.submit(codes, **kwargs).result(timeout)
+
+    def engine_alive(self) -> bool:
+        """True while the engine thread is serving (or before start)."""
+        return self._thread is None or self._thread.is_alive()
 
     def stats(self) -> dict:
         with self._lat_lock:
@@ -221,7 +245,10 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(200, {"ok": True})
+                # health must reflect the serving loop, not just this
+                # HTTP thread — a dead engine thread is a dead service
+                alive = server.engine_alive()
+                self._send(200 if alive else 503, {"ok": alive})
             elif self.path == "/stats":
                 self._send(200, server.stats())
             else:
@@ -246,8 +273,14 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                           ("seed", "temperature", "filter_thres", "top_p",
                            "priority", "deadline_s") if k in req}
                 handle = server.submit(codes, **kwargs)
+            except S.InvalidRequest as e:
+                self._send(400, e.record)       # caller error, not load
+                return
+            except S.QueueClosed as e:
+                self._send(503, e.record)       # shutting down
+                return
             except S.ServeRejected as e:
-                self._send(429, e.record)
+                self._send(429, e.record)       # backpressure
                 return
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": str(e)})

@@ -23,7 +23,8 @@ import pytest
 
 from dalle_pytorch_tpu.models import dalle as D
 from dalle_pytorch_tpu.models import vae as V
-from dalle_pytorch_tpu.serve import (DEADLINE_EXCEEDED, OK, QueueFull,
+from dalle_pytorch_tpu.serve import (DEADLINE_EXCEEDED, ERROR, OK,
+                                     InvalidRequest, QueueClosed, QueueFull,
                                      Request, RequestQueue, SamplingParams)
 from dalle_pytorch_tpu.serve.engine import Engine
 
@@ -235,6 +236,88 @@ class TestBackpressure:
         assert order == ["running", "high", "low"]
 
 
+class TestFaultHardening:
+    """A malformed or unlucky request must produce a typed reject/error —
+    never a dead serving loop (the no-hangs contract under faults)."""
+
+    def test_invalid_prompt_typed_reject_at_submit(self, bundle):
+        params, vae_params = bundle
+        from dalle_pytorch_tpu.serve.server import InferenceServer
+        server = InferenceServer(params, vae_params, CFG, num_slots=1,
+                                 queue_depth=4, decode_images=False)
+        too_long = tuple(range(CFG.text_seq_len + 1))
+        with pytest.raises(InvalidRequest) as ei:
+            server.submit(too_long)
+        rec = ei.value.record
+        assert rec["reason"] == "invalid_prompt"
+        assert rec["prompt_len"] == CFG.text_seq_len + 1
+        assert rec["max_prompt_len"] == CFG.text_seq_len
+        with pytest.raises(InvalidRequest):
+            server.submit(())
+        server.close()
+
+    def test_malformed_admission_errors_not_crashes(self, bundle):
+        """A raw queue has no prompt validation; the engine must turn an
+        impossible prompt into a typed error result at admission and keep
+        serving the well-formed request behind it."""
+        params, vae_params = bundle
+        ref = reference_tokens(params, vae_params, REQS[0])
+        queue = RequestQueue(max_depth=8)       # no max_prompt_len
+        engine = Engine(params, CFG, queue, num_slots=2)
+        h_bad = queue.submit(Request(
+            codes=tuple(range(CFG.text_seq_len + 3)), seed=0))
+        h_ok = queue.submit(REQS[0])
+        engine.run_until_idle()
+        res = h_bad.result(timeout=5)
+        assert res.status == ERROR
+        assert "invalid prompt length" in res.reason
+        np.testing.assert_array_equal(
+            np.asarray(h_ok.result(timeout=5).tokens), ref)
+
+    def test_run_loop_survives_step_exception(self, bundle):
+        """An exception out of a decode step must fail the in-slot
+        requests with typed error results and leave the serving thread
+        alive and correct for the next request."""
+        params, vae_params = bundle
+        queue = RequestQueue(max_depth=8)
+        engine = Engine(params, CFG, queue, num_slots=2)
+        good_fn = engine._decode_fn
+
+        def boom(*a, **k):
+            raise RuntimeError("injected decode fault")
+
+        h_bad = queue.submit(REQS[0])
+        engine._decode_fn = boom
+        stop = threading.Event()
+        t = threading.Thread(target=engine.run, args=(stop,), daemon=True)
+        t.start()
+        try:
+            res = h_bad.result(timeout=30)
+            assert res.status == ERROR
+            assert "injected decode fault" in res.reason
+            assert t.is_alive(), "serving loop died on a step exception"
+            # recovered: the same engine serves the next request with
+            # token-exact results (admission rewrites the slot state)
+            engine._decode_fn = good_fn
+            ref = reference_tokens(params, vae_params, REQS[1])
+            h_ok = queue.submit(REQS[1])
+            np.testing.assert_array_equal(
+                np.asarray(h_ok.result(timeout=60).tokens), ref)
+        finally:
+            stop.set()
+            t.join(10)
+
+    def test_submit_racing_close_is_typed_reject(self, bundle):
+        params, vae_params = bundle
+        from dalle_pytorch_tpu.serve.server import InferenceServer
+        server = InferenceServer(params, vae_params, CFG, num_slots=1,
+                                 queue_depth=4, decode_images=False)
+        server.close()
+        with pytest.raises(QueueClosed) as ei:
+            server.submit((1, 2))
+        assert ei.value.record["reason"] == "queue_closed"
+
+
 class TestBurstOccupancy:
     def test_burst_fills_slots_and_decodes_concurrently(self, bundle):
         """A burst larger than the pool keeps every slot busy — the
@@ -276,7 +359,49 @@ class TestServerPipeline:
                                        atol=1e-5)
             stats = server.stats()
             assert stats["completed"] == 1
-            assert stats["p50_latency_s"] > 0
+            # latency is recorded at fulfillment, AFTER postprocess time
+            # lands in total_s — the percentile must equal what the
+            # caller saw, not the decode-only number
+            assert stats["p50_latency_s"] == round(res.total_s, 4)
+        finally:
+            server.close()
+
+    def test_clip_scores_completed_text_span_like_one_shot(self, bundle):
+        """CLIP rerank through the pipeline scores the COMPLETED text
+        span — for a prompt shorter than text_seq_len the score must
+        match generate_images' rerank (which scores full[:, :text_seq_len]
+        including the model-sampled text tokens), not a zero-padded
+        prompt."""
+        params, vae_params = bundle
+        from dalle_pytorch_tpu.models import clip as C
+        from dalle_pytorch_tpu.serve.server import InferenceServer
+        clip_cfg = C.CLIPConfig(
+            dim_text=16, dim_image=16, dim_latent=16,
+            num_text_tokens=CFG.num_text_tokens,
+            text_enc_depth=1, text_seq_len=CFG.text_seq_len, text_heads=2,
+            visual_enc_depth=1, visual_heads=2,
+            visual_image_size=VCFG.image_size, visual_patch_size=8,
+            sparse_attn=False)
+        clip_params = C.clip_init(jax.random.PRNGKey(7), clip_cfg)
+        req = REQS[0]                       # len 3 < text_seq_len 8
+        text = jnp.asarray([req.codes], jnp.int32)
+        _, ref_scores = D.generate_images(
+            params, vae_params, text, cfg=CFG,
+            rng=jax.random.PRNGKey(req.seed),
+            clip_params=clip_params, clip_cfg=clip_cfg)
+
+        server = InferenceServer(params, vae_params, CFG, num_slots=2,
+                                 queue_depth=8, clip_params=clip_params,
+                                 clip_cfg=clip_cfg).start()
+        try:
+            res = server.generate(req.codes, seed=req.seed, timeout=60)
+            assert res.status == OK
+            assert len(res.text_tokens) == CFG.text_seq_len
+            np.testing.assert_array_equal(res.text_tokens[:len(req.codes)],
+                                          req.codes)
+            np.testing.assert_allclose(
+                res.clip_score, float(np.asarray(ref_scores)[0]),
+                rtol=1e-4, atol=1e-5)
         finally:
             server.close()
 
@@ -320,6 +445,30 @@ class TestServerPipeline:
                 stats = json.loads(resp.read())
             assert stats["completed"] == 1
             assert stats["decode_compiles"] == 1
+            # a malformed request is a 400 at the edge — it must never
+            # reach (and kill) the engine thread
+            import urllib.error
+            bad = json.dumps(
+                {"codes": list(range(CFG.text_seq_len + 1))}).encode()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/generate", data=bad,
+                    timeout=10)
+            assert ei.value.code == 400
+            assert json.loads(ei.value.read())["reason"] == "invalid_prompt"
+            # the serving loop is still alive and healthy afterwards
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10) as resp:
+                assert json.loads(resp.read())["ok"] is True
+            body2 = json.dumps({"codes": [6, 6], "seed": 5,
+                                "temperature": 1.3, "top_p": 0.9}).encode()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/generate", data=body2,
+                    timeout=60) as resp:
+                out2 = json.loads(resp.read())
+            assert out2["status"] == "ok"
+            ref2 = reference_tokens(params, vae_params, REQS[2])
+            assert out2["tokens"] == [int(t) for t in ref2]
         finally:
             httpd.shutdown()
             httpd.server_close()
