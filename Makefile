@@ -36,7 +36,9 @@ demo-rehearsal:  ## end-to-end demo pipeline, tiny knobs, scratch dirs
 demo:            ## the real trained demo on the chip
 	bash scripts/tpu_demo.sh
 
-lint:            ## syntax-check every python file and orchestrator script
+lint:            ## syntax check + jaxlint (the TPU-invariant AST rules)
 	$(CPU_ENV) python -m compileall -q dalle_pytorch_tpu tests scripts \
 	    bench.py __graft_entry__.py
 	for f in scripts/*.sh; do bash -n $$f || exit 1; done
+	$(CPU_ENV) python -m dalle_pytorch_tpu.analysis.jaxlint \
+	    dalle_pytorch_tpu tests bench.py

@@ -578,9 +578,11 @@ def setup_train(cfg, batch, mesh):
     params, opt_state = setup_sharded(params, opt, mesh)
     step = make_train_step(dalle_loss_fn(cfg), opt)
     data = shard_batch(mesh, {
-        "text": jax.random.randint(key, (batch, cfg.text_seq_len), 0,
+        "text": jax.random.randint(jax.random.fold_in(key, 1),
+                                   (batch, cfg.text_seq_len), 0,
                                    cfg.num_text_tokens),
-        "image": jax.random.randint(key, (batch, cfg.image_seq_len), 0,
+        "image": jax.random.randint(jax.random.fold_in(key, 2),
+                                    (batch, cfg.image_seq_len), 0,
                                     cfg.num_image_tokens),
     })
     return step, params, opt_state, data, key
@@ -1095,10 +1097,13 @@ def bench_kernels(args):
         if not name.startswith("flash_pallas"):
             # bwd_impl only changes the custom_vjp backward — re-checking
             # the byte-identical forward would just pay a second compile
+            # jaxlint: disable=JL004 — one compile per benched kernel,
+            # by design: the loop iterates distinct fns, not repeat calls
             o = jax.jit(fn)(q, k, v)
             r = ref(q, k, v)
             out[f"{name}_fwd_reldiff"] = float(
                 jnp.max(jnp.abs(o - r)) / jnp.max(jnp.abs(r)))
+        # jaxlint: disable=JL004 — ditto: each iteration jits a new fn once
         g = jax.jit(jax.grad(sq_loss(fn), argnums=(0, 1, 2)))(q, k, v)
         if ref not in ref_grads:
             ref_grads[ref] = jax.grad(sq_loss(ref),
@@ -1149,6 +1154,8 @@ def bench_kernels(args):
                              ("windowed", bs_win_big)):
                 _progress(f"kernels: timing sparse {name} fwd+bwd "
                           f"@ seq {ns}")
+                # jaxlint: disable=JL004 — one compile per benched kernel;
+                # the timed loop below reuses this wrapper
                 step = jax.jit(jax.grad(sq_loss(fn), argnums=(0, 1, 2)))
                 g = step(q2, k2, v2)
                 _fetch(g[0])                      # compile + warm
@@ -1233,6 +1240,8 @@ def bench_serve(args):
         SamplingParams
     from dalle_pytorch_tpu.serve.engine import Engine
 
+    from dalle_pytorch_tpu.analysis import guards
+
     cfg = build_cfg(args.tiny, depth=12 if not args.tiny else 2)
     key = jax.random.PRNGKey(0)
     params = jax.device_put(D.dalle_init(key, cfg, dtype=jnp.bfloat16))
@@ -1258,58 +1267,65 @@ def bench_serve(args):
 
     _progress(f"serve: compiling prefill + slot-batched decode "
               f"({num_slots} slots, seq {cfg.seq_len})")
-    # warm the jit cache outside the timed region (same discipline as
-    # time_steps' warmup)
-    h = queue.submit(Request(codes=(1,) * prompt_len, seed=0,
-                             sampling=SamplingParams()))
-    engine.run_until_idle()
-    h.result(timeout=60)
+    # the whole bench — warmup AND sweep — runs under the shared
+    # compile-count guard (analysis.guards): the decode program may
+    # trace exactly once, at warmup. Non-raising mode: a violation
+    # lands in the JSON record below instead of killing the sweep.
+    with guards.compile_count(lambda: engine.decode_traces, expect=1,
+                              label="serve decode program",
+                              raise_on_violation=False) as decode_guard:
+        # warm the jit cache outside the timed region (same discipline
+        # as time_steps' warmup)
+        h = queue.submit(Request(codes=(1,) * prompt_len, seed=0,
+                                 sampling=SamplingParams()))
+        engine.run_until_idle()
+        h.result(timeout=60)
 
-    results = []
-    for rps in loads:
-        base = {"offered_rps": rps, "requests": n_req}
-        occ0, steps0 = engine.occupancy_sum, engine.decode_steps
-        completed, rejected = [], 0
-        t0 = time.perf_counter()
-        next_arrival, submitted = t0, 0
-        pending = []
-        while submitted < n_req or pending:
-            now = time.perf_counter()
-            while submitted < n_req and now >= next_arrival:
-                try:
-                    pending.append(queue.submit(Request(
-                        codes=(1 + submitted % 7,) * prompt_len,
-                        seed=submitted, sampling=SamplingParams())))
-                except QueueFull:
-                    rejected += 1       # structured shed — counted, typed
-                submitted += 1
-                next_arrival += 1.0 / rps
-            engine.step_once()
-            done = [h for h in pending if h.done()]
-            for h in done:
-                completed.append(h.result())
-                pending.remove(h)
-        wall = time.perf_counter() - t0
-        lats = sorted(r.total_s for r in completed if r.ok)
-        n_ok = len(lats)
-        base.update({
-            "completed": n_ok, "rejected": rejected,
-            "throughput_imgs_per_s": round(n_ok / wall, 3),
-            "tokens_per_s": round(n_ok * tokens_per_req / wall, 1),
-            "p50_latency_ms": round(1e3 * stats_mod.median(lats), 1)
-            if lats else None,
-            "p95_latency_ms": round(
-                1e3 * lats[min(int(0.95 * n_ok), n_ok - 1)], 1)
-            if lats else None,
-            "wall_s": round(wall, 2),
-        })
-        # occupancy over THIS load point's steps, not the engine lifetime
-        base["mean_occupancy"] = round(
-            (engine.occupancy_sum - occ0)
-            / max(engine.decode_steps - steps0, 1), 3)
-        results.append(base)
-        _progress(f"serve: rps={rps} done ({n_ok} ok, {rejected} "
-                  f"rejected, {base['wall_s']}s)")
+        results = []
+        for rps in loads:
+            base = {"offered_rps": rps, "requests": n_req}
+            occ0, steps0 = engine.occupancy_sum, engine.decode_steps
+            completed, rejected = [], 0
+            t0 = time.perf_counter()
+            next_arrival, submitted = t0, 0
+            pending = []
+            while submitted < n_req or pending:
+                now = time.perf_counter()
+                while submitted < n_req and now >= next_arrival:
+                    try:
+                        pending.append(queue.submit(Request(
+                            codes=(1 + submitted % 7,) * prompt_len,
+                            seed=submitted, sampling=SamplingParams())))
+                    except QueueFull:
+                        rejected += 1       # structured shed — counted, typed
+                    submitted += 1
+                    next_arrival += 1.0 / rps
+                engine.step_once()
+                done = [h for h in pending if h.done()]
+                for h in done:
+                    completed.append(h.result())
+                    pending.remove(h)
+            wall = time.perf_counter() - t0
+            lats = sorted(r.total_s for r in completed if r.ok)
+            n_ok = len(lats)
+            base.update({
+                "completed": n_ok, "rejected": rejected,
+                "throughput_imgs_per_s": round(n_ok / wall, 3),
+                "tokens_per_s": round(n_ok * tokens_per_req / wall, 1),
+                "p50_latency_ms": round(1e3 * stats_mod.median(lats), 1)
+                if lats else None,
+                "p95_latency_ms": round(
+                    1e3 * lats[min(int(0.95 * n_ok), n_ok - 1)], 1)
+                if lats else None,
+                "wall_s": round(wall, 2),
+            })
+            # occupancy over THIS load point's steps, not the engine lifetime
+            base["mean_occupancy"] = round(
+                (engine.occupancy_sum - occ0)
+                / max(engine.decode_steps - steps0, 1), 3)
+            results.append(base)
+            _progress(f"serve: rps={rps} done ({n_ok} ok, {rejected} "
+                      f"rejected, {base['wall_s']}s)")
 
     snap = engine.stats()
     record = {
@@ -1323,11 +1339,10 @@ def bench_serve(args):
         "prefill_compiles": snap["prefill_compiles"],
         "devices": len(jax.devices()), "backend": jax.default_backend(),
     }
-    if snap["decode_compiles"] != 1:
+    if decode_guard.error is not None:
         # the one-compile contract IS the point of the fixed-shape slot
         # pool; a recompile mid-sweep is a correctness failure, not noise
-        record["error"] = (f"decode recompiled: {snap['decode_compiles']} "
-                           "traces for one engine (expected 1)")
+        record["error"] = str(decode_guard.error)
     return record
 
 

@@ -1,0 +1,119 @@
+"""Runtime guards: the dynamic twin of jaxlint's static rules.
+
+The two invariants the lint can only approximate from source — "this
+region performs no implicit host-device transfer" and "this program
+compiled exactly N times" — are checkable exactly at runtime, and both
+already had ad-hoc open-coded versions in the tree (``bench_serve``'s
+post-sweep ``decode_compiles != 1`` check, ``test_serve``'s
+``engine.decode_traces == 1`` asserts). These context managers are the
+one shared implementation: benches record violations, tests fail on
+them, and any future kernel test gets the same contract for one line.
+
+  * ``no_transfers()`` — ``jax.transfer_guard("disallow")``: implicit
+    transfers raise; EXPLICIT ``jax.device_put``/``jax.device_get``
+    still pass. That split is the point: a steady-state loop wrapped in
+    ``no_transfers()`` documents every intentional round-trip as an
+    explicit call at the transfer site (serve/engine.py's per-step token
+    fetch is the canonical allowance — ROADMAP "keep cur_tok/pos on
+    device"). Note the guard bites hardest on a real accelerator; the
+    CPU backend shares one memory space, so some copies never register.
+  * ``compile_count(counter, expect=N)`` — asserts a trace/compile
+    counter advanced by exactly N inside the block.
+  * ``counting(fn)`` — wrap a function so jit-tracing it is countable:
+    ``fn2 = counting(fn); jitted = jax.jit(fn2)``; ``fn2.traces``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Callable, Iterator, Optional
+
+
+class CompileCountError(AssertionError):
+    """A guarded region compiled a different number of programs than its
+    contract allows. Carries ``expected``/``actual`` for structured
+    reporting (bench records them instead of raising)."""
+
+    def __init__(self, label: str, expected, actual: int):
+        super().__init__(
+            f"{label}: expected {expected} compile(s), observed {actual}")
+        self.label = label
+        self.expected = expected
+        self.actual = actual
+
+
+class CompileCountGuard:
+    """State handed back by ``compile_count`` — ``delta()`` mid-block,
+    ``error`` after a non-raising exit."""
+
+    def __init__(self, counter: Callable[[], int], label: str):
+        self._counter = counter
+        self.label = label
+        self.start = counter()
+        self.error: Optional[CompileCountError] = None
+
+    def delta(self) -> int:
+        return self._counter() - self.start
+
+
+@contextlib.contextmanager
+def compile_count(counter: Callable[[], int], *, expect: Optional[int]
+                  = None, at_most: Optional[int] = None,
+                  label: str = "compile_count",
+                  raise_on_violation: bool = True
+                  ) -> Iterator[CompileCountGuard]:
+    """Assert that ``counter`` (a zero-arg callable returning a
+    monotonically increasing trace/compile count — e.g.
+    ``lambda: engine.decode_traces``) advances by exactly ``expect``
+    (or by at most ``at_most``) across the block.
+
+    ``raise_on_violation=False`` records the violation on the yielded
+    guard's ``.error`` instead of raising — bench_serve's mode, where a
+    recompile must land in the JSON record, not kill the sweep. A
+    violation is only checked on clean exit: if the body itself raised,
+    that error wins."""
+    if (expect is None) == (at_most is None):
+        raise ValueError("pass exactly one of expect= / at_most=")
+    guard = CompileCountGuard(counter, label)
+    yield guard
+    actual = guard.delta()
+    bad = actual != expect if expect is not None else actual > at_most
+    if bad:
+        want = expect if expect is not None else f"<= {at_most}"
+        guard.error = CompileCountError(label, want, actual)
+        if raise_on_violation:
+            raise guard.error
+
+
+@contextlib.contextmanager
+def no_transfers(level: str = "disallow") -> Iterator[None]:
+    """Forbid implicit host-device transfers inside the block
+    (``jax.transfer_guard``). Explicit ``jax.device_put`` /
+    ``jax.device_get`` calls still pass under the default ``disallow``
+    level — intentional round-trips must be spelled at the site they
+    happen. ``level="log"`` audits instead of failing;
+    ``"disallow_explicit"`` forbids even the explicit escape hatch."""
+    import jax
+    with jax.transfer_guard(level):
+        yield
+
+
+def counting(fn: Callable) -> Callable:
+    """Wrap ``fn`` so each trace (python execution) bumps
+    ``wrapped.traces`` — the counter jit re-runs only when it compiles.
+    Pair with ``compile_count``:
+
+        traced = counting(step_fn)
+        jitted = jax.jit(traced)
+        with compile_count(lambda: traced.traces, expect=1):
+            for batch in data:
+                jitted(params, batch)
+    """
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        wrapped.traces += 1
+        return fn(*args, **kwargs)
+
+    wrapped.traces = 0
+    return wrapped

@@ -1,0 +1,990 @@
+"""jaxlint — AST lint for this repo's TPU invariants (stdlib only).
+
+``make lint`` was ``compileall`` — a syntax check — while the invariants
+that actually decide whether the chip runs fast live in reviewers' heads:
+no host syncs inside traced code, no recompiles of the serve decode
+program, no PRNG key reused across draws, no wall-clock ``time.time()``
+in duration math. Serving-stack papers (PAPERS.md: Ragged Paged
+Attention; Serving Gemma on Cloud TPU) name recompiles and host-device
+syncs as the silent TPU killers; both are exactly the class of defect an
+AST pass can catch before anything is compiled. docs/STATIC_ANALYSIS.md
+is the rule catalog with one real bug from this repo's history per rule.
+
+Scope and philosophy: per-file analysis, tuned to THIS codebase's idioms
+(``jax.jit(self._method)``, ``fn = jax.jit(pre)`` caches, bench's
+``run = jax.jit(...)`` timing harness). Rules prefer missing a finding
+over flagging working idioms — the gate only stays on in CI if the
+merged tree lints clean. Every finding can be silenced in place with
+
+    # jaxlint: disable=JL001 — reason why this one is fine
+
+on the offending line (or the line above); the reason is part of the
+convention, not enforced syntax.
+
+Usage:
+    jaxlint [paths...] [--json] [--select JL001,..] [--ignore JL00x,..]
+    python -m dalle_pytorch_tpu.analysis.jaxlint dalle_pytorch_tpu tests
+
+Exit status: 0 clean, 1 findings, 2 usage/parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import json
+import re
+import sys
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# rule id -> (slug, one-line description). docs/STATIC_ANALYSIS.md holds
+# the long-form rationale; keep the two in sync.
+RULES: Dict[str, Tuple[str, str]] = {
+    "JL001": ("host-sync-in-jit",
+              "host-device sync (.item/.tolist/np.asarray/int()) reachable "
+              "from traced code, or a host round-trip on a jitted "
+              "program's output"),
+    "JL002": ("traced-branch",
+              "python if/while on a traced argument — trace error or "
+              "silent recompile per value"),
+    "JL003": ("rng-key-reuse",
+              "same PRNG key consumed by two draws without an "
+              "intervening split/fold_in"),
+    "JL004": ("recompile-hazard",
+              "jit construction that retraces per call (jit() in a loop, "
+              "non-int static_argnums, static+donated overlap)"),
+    "JL005": ("loop-closure-in-jit",
+              "jitted def closes over a loop variable — late binding + "
+              "one compile per distinct value"),
+    "JL006": ("use-after-donate",
+              "buffer referenced after being donated via donate_argnums"),
+    "JL007": ("wallclock-timing",
+              "time.time() — durations must use perf_counter; epoch "
+              "stamps carry an explicit disable comment"),
+    "JL008": ("effect-in-jit",
+              "print/time.* side effect inside traced code — runs at "
+              "trace time only (or burns a callback into the program)"),
+}
+
+# Wrappers whose function-valued argument is traced by JAX. Used to mark
+# trace roots beyond literal @jit decoration.
+_TRACE_WRAPPERS = {
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad", "remat",
+    "checkpoint", "scan", "while_loop", "fori_loop", "cond", "switch",
+    "map", "shard_map", "custom_vjp", "custom_jvp", "linearize", "vjp",
+    "jvp", "hessian", "jacfwd", "jacrev", "associative_scan",
+}
+_JIT_NAMES = {"jit", "pjit"}
+# jax.random consumers that burn entropy; split/fold_in/PRNGKey derive.
+_RNG_DERIVE = {"split", "fold_in", "PRNGKey", "key", "key_data",
+               "wrap_key_data", "clone"}
+_SYNC_ATTRS = {"item", "tolist"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    @property
+    def slug(self) -> str:
+        return RULES[self.rule][0]
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "slug": self.slug, "path": self.path,
+                "line": self.line, "col": self.col,
+                "message": self.message}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"({self.slug}) {self.message}")
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.random.normal' for a Name/Attribute chain, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _last(node: ast.AST) -> str:
+    """Final component of a Name/Attribute chain ('' otherwise)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for expressions that build a jitted callable: ``jax.jit``,
+    ``jit``, ``pjit``, ``jax.jit(...)`` (configured), and
+    ``partial(jax.jit, ...)``."""
+    if _last(node) in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        if _last(node.func) in _JIT_NAMES:
+            return True
+        if _last(node.func) == "partial" and node.args \
+                and _is_jit_expr(node.args[0]):
+            return True
+    return False
+
+
+def _jit_call_of(node: ast.AST) -> Optional[ast.Call]:
+    """The ``jit(...)`` Call carrying kwargs, if ``node`` is one (either
+    bare or partial-wrapped)."""
+    if isinstance(node, ast.Call):
+        if _last(node.func) in _JIT_NAMES:
+            return node
+        if _last(node.func) == "partial" and node.args \
+                and _is_jit_expr(node.args[0]):
+            return node
+    return None
+
+
+def _const_ints(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """(ints,) for Constant int / tuple/list of Constant ints, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int) \
+                    and not isinstance(el.value, bool):
+                out.append(el.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """One pass collecting everything the rules need: import aliases,
+    function defs, jit-wrapped names, and trace roots."""
+
+    def __init__(self) -> None:
+        self.functions: List[ast.FunctionDef] = []
+        self.parent_fn: Dict[ast.AST, Optional[ast.AST]] = {}
+        self.np_aliases: Set[str] = set()
+        self.time_aliases: Set[str] = set()
+        self.random_aliases: Set[str] = {"jax.random"}
+        self.trace_roots: Set[ast.AST] = set()
+        # names (vars or attribute leaves like ``_decode_fn``) assigned
+        # from a jit expression anywhere in the module, with donated
+        # positions when statically known
+        self.jitted_names: Dict[str, Tuple[int, ...]] = {}
+        self._fn_stack: List[ast.AST] = []
+
+    # -- imports -----------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            alias = a.asname or a.name.split(".")[0]
+            if a.name == "numpy":
+                self.np_aliases.add(alias)
+            elif a.name == "time":
+                self.time_aliases.add(alias)
+            elif a.name == "jax.random" and a.asname:
+                self.random_aliases.add(a.asname)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "jax":
+            for a in node.names:
+                if a.name == "random":
+                    self.random_aliases.add(a.asname or "random")
+        self.generic_visit(node)
+
+    # -- defs --------------------------------------------------------------
+    def _visit_fn(self, node) -> None:
+        self.functions.append(node)
+        self.parent_fn[node] = self._fn_stack[-1] if self._fn_stack \
+            else None
+        for dec in node.decorator_list:
+            if _is_jit_expr(dec) or _last(dec) in _TRACE_WRAPPERS:
+                self.trace_roots.add(node)
+        self._fn_stack.append(node)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -- jit-wrapped names and trace roots by reference --------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        jc = node.value if _is_jit_expr(node.value) \
+            and isinstance(node.value, ast.Call) else None
+        if jc is not None:
+            donated: Tuple[int, ...] = ()
+            call = _jit_call_of(node.value)
+            if call is not None:
+                for kw in call.keywords:
+                    if kw.arg == "donate_argnums":
+                        donated = _const_ints(kw.value) or ()
+            for tgt in node.targets:
+                name = _last(tgt)
+                if name:
+                    self.jitted_names[name] = donated
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # mark `jax.jit(fn)` / `lax.scan(body, ...)` function arguments
+        # as trace roots (matched by name against defs in this module)
+        if _last(node.func) in _TRACE_WRAPPERS:
+            for arg in node.args:
+                ref = _last(arg)
+                if ref:
+                    self._mark_by_name(ref)
+        self.generic_visit(node)
+
+    def _mark_by_name(self, name: str) -> None:
+        for fn in self.functions:
+            if fn.name == name:
+                self.trace_roots.add(fn)
+
+    def finalize(self, tree: ast.Module) -> None:
+        """Late `jax.jit(name)` references may precede the def in visit
+        order; re-resolve every wrapper reference, then propagate traced
+        reachability through same-module calls and nesting."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _last(node.func) in _TRACE_WRAPPERS:
+                for arg in node.args:
+                    ref = _last(arg)
+                    if ref:
+                        self._mark_by_name(ref)
+        by_name: Dict[str, List[ast.AST]] = {}
+        for fn in self.functions:
+            by_name.setdefault(fn.name, []).append(fn)
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                if fn in self.trace_roots:
+                    continue
+                parent = self.parent_fn.get(fn)
+                if parent is not None and parent in self.trace_roots:
+                    # a def nested in traced code is traced when called
+                    self.trace_roots.add(fn)
+                    changed = True
+                    continue
+            for root in list(self.trace_roots):
+                for node in ast.walk(root):
+                    if isinstance(node, ast.Call):
+                        callee = _last(node.func)
+                        for fn in by_name.get(callee, ()):
+                            if fn not in self.trace_roots:
+                                self.trace_roots.add(fn)
+                                changed = True
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+_DISABLE_RE = re.compile(r"jaxlint:\s*disable=([A-Za-z0-9_,\-]+)")
+
+
+def _suppressions(src: str) -> Dict[int, Set[str]]:
+    """line -> set of suppressed rule ids. A trailing comment suppresses
+    its own line; a comment-only line also suppresses the next line (for
+    statements too long to share a line with their waiver)."""
+    slug_to_id = {slug: rid for rid, (slug, _) in RULES.items()}
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except tokenize.TokenizeError:
+        return out
+    code_lines = set()
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT:
+            m = _DISABLE_RE.search(tok.string)
+            if not m:
+                continue
+            rules: Set[str] = set()
+            for part in m.group(1).split(","):
+                part = part.strip()
+                if part.lower() == "all":
+                    rules |= set(RULES)
+                elif part.upper() in RULES:
+                    rules.add(part.upper())
+                elif part in slug_to_id:
+                    rules.add(slug_to_id[part])
+            out.setdefault(tok.start[0], set()).update(rules)
+        elif tok.type not in (tokenize.NL, tokenize.NEWLINE,
+                              tokenize.INDENT, tokenize.DEDENT,
+                              tokenize.ENCODING, tokenize.ENDMARKER):
+            code_lines.add(tok.start[0])
+    max_line = max(code_lines, default=0)
+    for line in list(out):
+        if line in code_lines:
+            continue
+        # standalone waiver: skip the rest of its comment block and
+        # cover the first code line after it
+        nxt = line + 1
+        while nxt <= max_line and nxt not in code_lines:
+            nxt += 1
+        out.setdefault(nxt, set()).update(out[line])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-rule checks
+# ---------------------------------------------------------------------------
+
+def _walk_no_nested_fns(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body without descending into nested defs (each
+    function scope is analyzed on its own)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _params(fn) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return {n for n in names if n not in ("self", "cls")}
+
+
+_SCALAR_ANN = {"int", "float", "bool", "str", "bytes", "Optional"}
+
+
+def _likely_traced_params(fn) -> Set[str]:
+    """Arguments that plausibly receive tracers. Codebase idiom: traced
+    arrays ride in positional, unannotated (or Array-annotated) slots;
+    keyword-only args and scalar-annotated args are trace-time config
+    (``causal: bool``, ``*, scale, block_k``) — python branches on them
+    are legitimate specialization, not tracer reads."""
+    out: Set[str] = set()
+    for p in fn.args.posonlyargs + fn.args.args:
+        if p.arg in ("self", "cls"):
+            continue
+        ann = p.annotation
+        if ann is not None:
+            names = {_last(n) for n in ast.walk(ann)
+                     if isinstance(n, (ast.Name, ast.Attribute))}
+            names |= {n.value for n in ast.walk(ann)
+                      if isinstance(n, ast.Constant)
+                      and isinstance(n.value, str)}
+            if names & _SCALAR_ANN and not names & {"Array", "ndarray",
+                                                    "ArrayLike"}:
+                continue
+        out.add(p.arg)
+    return out
+
+
+def _static_params(fn) -> Set[str]:
+    """Best-effort static_argnames/static_argnums from a jit decorator —
+    those arguments are concrete python values, not tracers."""
+    out: Set[str] = set()
+    a = fn.args
+    positional = [p.arg for p in a.posonlyargs + a.args]
+    for dec in fn.decorator_list:
+        call = _jit_call_of(dec)
+        if call is None:
+            continue
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                if isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    out.add(kw.value.value)
+                elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                    for el in kw.value.elts:
+                        if isinstance(el, ast.Constant) \
+                                and isinstance(el.value, str):
+                            out.add(el.value)
+            elif kw.arg == "static_argnums":
+                for i in _const_ints(kw.value) or ():
+                    if 0 <= i < len(positional):
+                        out.add(positional[i])
+    return out
+
+
+def _check_traced_bodies(idx: _ModuleIndex, path: str,
+                         findings: List[Finding]) -> None:
+    """JL001 (syncs in traced code), JL002 (traced branches), JL008
+    (print/time effects in traced code)."""
+    for fn in idx.trace_roots:
+        params = _likely_traced_params(fn) - _static_params(fn)
+        for node in _walk_no_nested_fns(fn):
+            if isinstance(node, ast.Call):
+                self_sync = isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_ATTRS and not node.args
+                if self_sync:
+                    findings.append(Finding(
+                        "JL001", path, node.lineno, node.col_offset,
+                        f".{node.func.attr}() inside traced code blocks "
+                        f"on the device and breaks the trace"))
+                    continue
+                fname = _last(node.func)
+                base = _dotted(node.func).rsplit(".", 1)[0] \
+                    if isinstance(node.func, ast.Attribute) else ""
+                if fname in ("asarray", "array") \
+                        and base in idx.np_aliases and node.args \
+                        and not isinstance(node.args[0],
+                                           (ast.Constant, ast.List,
+                                            ast.Tuple)):
+                    findings.append(Finding(
+                        "JL001", path, node.lineno, node.col_offset,
+                        f"{base}.{fname}() inside traced code forces a "
+                        f"host transfer (use jnp, or hoist the constant)"))
+                elif fname == "device_get":
+                    findings.append(Finding(
+                        "JL001", path, node.lineno, node.col_offset,
+                        "device_get inside traced code is a host sync"))
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in ("int", "float", "bool") \
+                        and len(node.args) == 1 \
+                        and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id in params:
+                    findings.append(Finding(
+                        "JL001", path, node.lineno, node.col_offset,
+                        f"{node.func.id}() on traced argument "
+                        f"'{node.args[0].id}' concretizes the tracer "
+                        f"(host sync / TracerError)"))
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id == "print":
+                    findings.append(Finding(
+                        "JL008", path, node.lineno, node.col_offset,
+                        "print() in traced code runs at trace time only "
+                        "— use jax.debug.print for runtime values"))
+                elif base in idx.time_aliases:
+                    findings.append(Finding(
+                        "JL008", path, node.lineno, node.col_offset,
+                        f"time.{fname}() in traced code is evaluated "
+                        f"once at trace time, not per step"))
+            elif isinstance(node, (ast.If, ast.While)):
+                traced = _traced_names_in_test(node.test, params)
+                if traced:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    findings.append(Finding(
+                        "JL002", path, node.lineno, node.col_offset,
+                        f"python `{kind}` on traced argument(s) "
+                        f"{', '.join(sorted(traced))} — use lax.cond/"
+                        f"while_loop or mark the argument static"))
+
+
+def _traced_names_in_test(test: ast.AST, params: Set[str]) -> Set[str]:
+    """Parameter names whose VALUE the test branches on. `x is None`,
+    `isinstance(x, ...)`, `len(x)` and attribute access (config objects)
+    are trace-time python facts, not tracer reads."""
+    if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return set()
+    skip: Set[ast.AST] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call) \
+                and _last(node.func) in ("isinstance", "len", "getattr",
+                                         "hasattr", "callable"):
+            for sub in ast.walk(node):
+                skip.add(sub)
+        elif isinstance(node, ast.Attribute):
+            for sub in ast.walk(node):
+                skip.add(sub)
+        elif isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            for sub in ast.walk(node):
+                skip.add(sub)
+    return {node.id for node in ast.walk(test)
+            if isinstance(node, ast.Name) and node.id in params
+            and node not in skip}
+
+
+def _check_sync_on_jit_output(idx: _ModuleIndex, path: str,
+                              findings: List[Finding]) -> None:
+    """JL001's host-loop half: a value returned by a known jit-wrapped
+    callable, fetched to the host in the same function via
+    np.asarray/.item()/device_get. This is the per-step round-trip the
+    ROADMAP flags in the serve decode loop — legitimate terminal fetches
+    carry a disable comment saying why the value must leave the device."""
+    if not idx.jitted_names:
+        return
+    for fn in idx.functions:
+        # flow-ordered events: a sync only fires on a name that is a
+        # jit output AT THAT POINT — bound from a jitted call earlier
+        # and not rebound to host data in between
+        events: List[Tuple[int, int, int, str, str]] = []
+        for node in _walk_no_nested_fns(fn):
+            if isinstance(node, ast.Assign):
+                kind = "jitbind" if isinstance(node.value, ast.Call) \
+                    and _last(node.value.func) in idx.jitted_names \
+                    else "bind"
+                for tgt in node.targets:
+                    els = tgt.elts if isinstance(tgt, (ast.Tuple,
+                                                       ast.List)) \
+                        else [tgt]
+                    for el in els:
+                        if isinstance(el, ast.Name):
+                            # binds sort after same-line value-side syncs
+                            events.append((node.lineno, 1,
+                                           el.col_offset, kind, el.id))
+            elif isinstance(node, (ast.AugAssign, ast.For)):
+                tgt = node.target
+                for el in ast.walk(tgt):
+                    if isinstance(el, ast.Name):
+                        events.append((el.lineno, 1, el.col_offset,
+                                       "bind", el.id))
+            elif isinstance(node, ast.Call):
+                fname = _last(node.func)
+                base = _dotted(node.func).rsplit(".", 1)[0] \
+                    if isinstance(node.func, ast.Attribute) else ""
+                arg: Optional[str] = None
+                if fname in ("asarray", "array") \
+                        and base in idx.np_aliases and node.args:
+                    arg = node.args[0].id \
+                        if isinstance(node.args[0], ast.Name) else None
+                elif fname == "device_get" and node.args:
+                    arg = node.args[0].id \
+                        if isinstance(node.args[0], ast.Name) else None
+                elif fname in _SYNC_ATTRS \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name):
+                    arg = node.func.value.id
+                if arg is not None:
+                    events.append((node.lineno, 0, node.col_offset,
+                                   "sync:" + fname, arg))
+        events.sort()
+        jit_outputs: Set[str] = set()
+        for lineno, _, col, kind, name in events:
+            if kind == "jitbind":
+                jit_outputs.add(name)
+            elif kind == "bind":
+                jit_outputs.discard(name)
+            elif name in jit_outputs:
+                findings.append(Finding(
+                    "JL001", path, lineno, col,
+                    f"host round-trip: {kind[5:]} on '{name}', the "
+                    f"output of a jitted program — keep it on device or "
+                    f"fetch asynchronously (ROADMAP: one round-trip per "
+                    f"decode step)"))
+
+
+def _check_rng_reuse(idx: _ModuleIndex, path: str,
+                     findings: List[Finding]) -> None:
+    """JL003: straight-line reuse of a PRNG key by two draws, and reuse
+    across loop iterations of a key defined outside the loop."""
+
+    def consumer_calls(expr: ast.AST) -> List[Tuple[ast.Call, str]]:
+        out = []
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                base = _dotted(node.func).rsplit(".", 1)[0]
+                if base in idx.random_aliases \
+                        and node.func.attr not in _RNG_DERIVE \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Name):
+                    out.append((node, node.args[0].id))
+        return sorted(out, key=lambda t: (t[0].lineno, t[0].col_offset))
+
+    def assigned_names(stmt: ast.stmt) -> Set[str]:
+        out: Set[str] = set()
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.For):
+            targets = [stmt.target]
+        for tgt in targets:
+            for node in ast.walk(tgt):
+                if isinstance(node, ast.Name):
+                    out.add(node.id)
+        return out
+
+    def exprs_of(stmt: ast.stmt) -> List[ast.AST]:
+        if isinstance(stmt, ast.Assign):
+            return [stmt.value]
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            return [stmt.value] if stmt.value is not None else []
+        if isinstance(stmt, ast.Expr):
+            return [stmt.value]
+        if isinstance(stmt, ast.Return):
+            return [stmt.value] if stmt.value is not None else []
+        if isinstance(stmt, ast.For):
+            return [stmt.iter]
+        if isinstance(stmt, (ast.While, ast.If)):
+            return [stmt.test]
+        return []
+
+    def run_block(stmts: Sequence[ast.stmt], state: Dict[str, int],
+                  in_loop_retry: bool = False) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for expr in exprs_of(stmt):
+                for call, key in consumer_calls(expr):
+                    if key in state:
+                        suffix = " (reused across loop iterations)" \
+                            if in_loop_retry else ""
+                        f = Finding(
+                            "JL003", path, call.lineno, call.col_offset,
+                            f"PRNG key '{key}' already consumed at line "
+                            f"{state[key]} — split or fold_in before "
+                            f"drawing again{suffix}")
+                        if f not in findings:
+                            findings.append(f)
+                    else:
+                        state[key] = call.lineno
+            cleared = assigned_names(stmt)
+            for name in cleared:
+                state.pop(name, None)
+            if isinstance(stmt, ast.If):
+                s_if, s_else = dict(state), dict(state)
+                run_block(stmt.body, s_if, in_loop_retry)
+                run_block(stmt.orelse, s_else, in_loop_retry)
+                # join = MUST-consumed: a key counts as consumed after
+                # the `if` only when BOTH arms end with it consumed —
+                # an arm that re-derived it (split/fold_in reassignment)
+                # drops it from that arm's final state, so key-rotation
+                # in every branch legally resets the key
+                state.clear()
+                for key in s_if.keys() & s_else.keys():
+                    state[key] = min(s_if[key], s_else[key])
+            elif isinstance(stmt, (ast.For, ast.While)):
+                inner = dict(state)
+                run_block(stmt.body, inner, in_loop_retry)
+                # second pass simulates iteration 2: a key consumed in
+                # pass 1 and not reassigned inside the loop trips here
+                run_block(stmt.body, inner, in_loop_retry=True)
+                state.update(inner)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                run_block(stmt.body, state, in_loop_retry)
+            elif isinstance(stmt, ast.Try):
+                run_block(stmt.body, state, in_loop_retry)
+                for h in stmt.handlers:
+                    run_block(h.body, dict(state), in_loop_retry)
+                run_block(stmt.orelse, state, in_loop_retry)
+                run_block(stmt.finalbody, state, in_loop_retry)
+
+    for fn in idx.functions:
+        run_block(fn.body, {})
+
+
+def _check_recompile_hazards(idx: _ModuleIndex, path: str, tree: ast.Module,
+                             findings: List[Finding]) -> None:
+    """JL004: jit construction inside a loop body (a fresh wrapper per
+    iteration defeats the compile cache), suspicious static_argnums, and
+    arguments that are both static and donated."""
+    loops = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.For, ast.While))]
+    in_loop: Set[ast.AST] = set()
+    for loop in loops:
+        for stmt in loop.body + list(getattr(loop, "orelse", [])):
+            # nested defs are skipped (their jits compile when THEY are
+            # called — JL005's domain), but siblings after a lambda in
+            # the same statement still count as in-loop
+            in_loop.add(stmt)
+            in_loop.update(_walk_no_nested_fns(stmt))
+    for node in ast.walk(tree):
+        call = _jit_call_of(node) if isinstance(node, ast.Call) else None
+        if call is None:
+            continue
+        if node in in_loop:
+            findings.append(Finding(
+                "JL004", path, call.lineno, call.col_offset,
+                "jit() constructed inside a loop — build the wrapper "
+                "once outside (each construction risks a retrace and "
+                "pays dispatch-cache misses)"))
+        static = donated = None
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                static = _const_ints(kw.value)
+                if static is None and isinstance(
+                        kw.value, (ast.Constant, ast.Tuple, ast.List)):
+                    findings.append(Finding(
+                        "JL004", path, kw.value.lineno,
+                        kw.value.col_offset,
+                        "static_argnums must be ints — non-int static "
+                        "arguments (arrays, lists) are unhashable or "
+                        "retrace per value"))
+            elif kw.arg == "donate_argnums":
+                donated = _const_ints(kw.value)
+        if static and donated and set(static) & set(donated):
+            both = sorted(set(static) & set(donated))
+            findings.append(Finding(
+                "JL004", path, call.lineno, call.col_offset,
+                f"argnums {both} are both static and donated — a "
+                f"hashed-constant buffer cannot be donated"))
+
+
+def _check_loop_closures(idx: _ModuleIndex, path: str, tree: ast.Module,
+                         findings: List[Finding]) -> None:
+    """JL005: a jitted def inside a loop body reading the loop variable
+    from its closure — late binding means every def sees the LAST value,
+    and each distinct value retraces."""
+    for loop in ast.walk(tree):
+        if not isinstance(loop, ast.For):
+            continue
+        loop_vars = {n.id for n in ast.walk(loop.target)
+                     if isinstance(n, ast.Name)}
+        for stmt in loop.body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                jitted = any(_is_jit_expr(d) for d in node.decorator_list)
+                if not jitted and node in idx.trace_roots:
+                    jitted = True
+                if not jitted:
+                    continue
+                params = _params(node) | {
+                    d.arg for d in node.args.defaults
+                    if isinstance(d, ast.arg)}
+                default_names = set()
+                for d in node.args.defaults + node.args.kw_defaults:
+                    if isinstance(d, ast.Name):
+                        default_names.add(d.id)   # i=i rebinding is fine
+                captured = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) \
+                            and isinstance(sub.ctx, ast.Load) \
+                            and sub.id in loop_vars \
+                            and sub.id not in params:
+                        captured.add(sub.id)
+                captured -= {n for n in captured if n in default_names
+                             and n in params}
+                if captured:
+                    findings.append(Finding(
+                        "JL005", path, node.lineno, node.col_offset,
+                        f"jitted '{node.name}' closes over loop "
+                        f"variable(s) {sorted(captured)} — bind via a "
+                        f"default arg or pass as input (late binding + "
+                        f"retrace per value)"))
+
+
+def _check_use_after_donate(idx: _ModuleIndex, path: str,
+                            findings: List[Finding]) -> None:
+    """JL006: positional buffers passed at a donated argnum, then read
+    again later in the same function — donated device buffers are
+    deallocated by XLA; the read returns garbage or raises."""
+    for fn in idx.functions:
+        donors: Dict[str, Tuple[int, ...]] = {}
+        for node in _walk_no_nested_fns(fn):
+            if isinstance(node, ast.Assign) and _is_jit_expr(node.value):
+                call = _jit_call_of(node.value)
+                if call is None:
+                    continue
+                donated: Tuple[int, ...] = ()
+                for kw in call.keywords:
+                    if kw.arg == "donate_argnums":
+                        donated = _const_ints(kw.value) or ()
+                if donated:
+                    for tgt in node.targets:
+                        name = _last(tgt)
+                        if name:
+                            donors[name] = donated
+        donors.update({n: d for n, d in idx.jitted_names.items() if d})
+        if not donors:
+            continue
+        events: List[Tuple[int, int, str, str, str]] = []
+        for node in _walk_no_nested_fns(fn):
+            if isinstance(node, ast.Call):
+                callee = _last(node.func)
+                if callee in donors:
+                    for i in donors[callee]:
+                        if i < len(node.args) \
+                                and isinstance(node.args[i], ast.Name):
+                            events.append((node.lineno, node.col_offset,
+                                           "donate", node.args[i].id,
+                                           callee))
+            if isinstance(node, ast.Name):
+                kind = "load" if isinstance(node.ctx, ast.Load) \
+                    else "store"
+                events.append((node.lineno, node.col_offset, kind,
+                               node.id, ""))
+        # within a line, the value side (loads, the donating call)
+        # happens before the assignment target rebinds — `p = step(p)`
+        # must clear p's donation, not trip over it
+        events.sort(key=lambda e: (e[0], e[2] == "store", e[1]))
+        donated_at: Dict[str, Tuple[int, str]] = {}
+        for lineno, col, kind, name, callee in events:
+            if kind == "donate":
+                donated_at[name] = (lineno, callee)
+            elif kind == "store":
+                donated_at.pop(name, None)
+            elif kind == "load" and name in donated_at:
+                dl, callee = donated_at[name]
+                if lineno > dl:   # the donating call's own args are fine
+                    findings.append(Finding(
+                        "JL006", path, lineno, col,
+                        f"'{name}' was donated to {callee}() at line "
+                        f"{dl} — its device buffer is gone; rebind the "
+                        f"result instead"))
+                    donated_at.pop(name, None)   # one finding per donation
+
+
+def _check_wallclock(idx: _ModuleIndex, path: str, tree: ast.Module,
+                     traced_spans: List[Tuple[int, int]],
+                     findings: List[Finding]) -> None:
+    """JL007: every time.time() call. Durations must use perf_counter
+    (time.time steps under NTP slew — bench latencies went negative on
+    the TPU host once); epoch timestamps in event records are the legal
+    use and carry the waiver comment."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "time" \
+                and _dotted(node.func.value) in idx.time_aliases:
+            if any(a <= node.lineno <= b for a, b in traced_spans):
+                continue                    # JL008 already reports it
+            findings.append(Finding(
+                "JL007", path, node.lineno, node.col_offset,
+                "time.time() — use time.perf_counter() for durations; "
+                "an epoch timestamp needs an explicit "
+                "`# jaxlint: disable=JL007` waiver"))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+# jaxlint's own true-positive test corpus must not fail the repo gate
+DEFAULT_EXCLUDES = ("fixtures/jaxlint",)
+
+
+def lint_source(src: str, path: str = "<string>") -> List[Finding]:
+    tree = ast.parse(src, filename=path)
+    idx = _ModuleIndex()
+    idx.visit(tree)
+    idx.finalize(tree)
+    findings: List[Finding] = []
+    traced_spans = [(fn.lineno, max(getattr(fn, "end_lineno", fn.lineno),
+                                    fn.lineno))
+                    for fn in idx.trace_roots]
+    _check_traced_bodies(idx, path, findings)
+    _check_sync_on_jit_output(idx, path, findings)
+    _check_rng_reuse(idx, path, findings)
+    _check_recompile_hazards(idx, path, tree, findings)
+    _check_loop_closures(idx, path, tree, findings)
+    _check_use_after_donate(idx, path, findings)
+    _check_wallclock(idx, path, tree, traced_spans, findings)
+
+    supp = _suppressions(src)
+    findings = [f for f in findings
+                if f.rule not in supp.get(f.line, set())]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    # two rules can hit one call site; keep the first per (line, col, rule)
+    seen: Set[Tuple] = set()
+    out = []
+    for f in findings:
+        k = (f.line, f.col, f.rule)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+def lint_file(path: Path) -> List[Finding]:
+    src = path.read_text(encoding="utf-8")
+    return lint_source(src, str(path))
+
+
+def iter_py_files(paths: Sequence[str],
+                  excludes: Sequence[str] = DEFAULT_EXCLUDES
+                  ) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        pp = Path(p)
+        if pp.is_dir():
+            out.extend(sorted(pp.rglob("*.py")))
+        elif pp.suffix == ".py":
+            out.append(pp)
+    return [p for p in out
+            if not any(ex in str(p) for ex in excludes)
+            and "__pycache__" not in str(p)]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="AST lint for this repo's TPU invariants "
+                    "(docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*", default=["dalle_pytorch_tpu"],
+                    help="files or directories (default: the package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule ids to run (default all)")
+    ap.add_argument("--ignore", default="",
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--no-default-excludes", action="store_true",
+                    help=f"also lint {DEFAULT_EXCLUDES} (the linter's "
+                         f"own true-positive corpus)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, (slug, desc) in sorted(RULES.items()):
+            print(f"{rid}  {slug:22s} {desc}")
+        return 0
+
+    select = {r.strip().upper() for r in args.select.split(",")
+              if r.strip()}
+    ignore = {r.strip().upper() for r in args.ignore.split(",")
+              if r.strip()}
+    bad = (select | ignore) - set(RULES)
+    if bad:
+        print(f"jaxlint: unknown rule(s): {', '.join(sorted(bad))}",
+              file=sys.stderr)
+        return 2
+
+    excludes = () if args.no_default_excludes else DEFAULT_EXCLUDES
+    files = iter_py_files(args.paths, excludes)
+    if not files:
+        print("jaxlint: no python files found", file=sys.stderr)
+        return 2
+
+    findings: List[Finding] = []
+    errors = 0
+    for f in files:
+        try:
+            findings.extend(lint_file(f))
+        except SyntaxError as e:
+            errors += 1
+            print(f"{f}:{e.lineno or 0}:0: parse error: {e.msg}",
+                  file=sys.stderr)
+    if select:
+        findings = [f for f in findings if f.rule in select]
+    if ignore:
+        findings = [f for f in findings if f.rule not in ignore]
+
+    if args.as_json:
+        print(json.dumps({"findings": [f.to_dict() for f in findings],
+                          "files": len(files)}, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"jaxlint: {n} finding{'s' if n != 1 else ''} in "
+              f"{len(files)} files", file=sys.stderr)
+    if errors:
+        return 2
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

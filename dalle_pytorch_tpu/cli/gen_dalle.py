@@ -216,7 +216,7 @@ def main(argv=None):
     else:
         images = np.asarray(out)
 
-    ts = int(time.time())
+    ts = int(time.time())  # jaxlint: disable=JL007 — filename epoch stamp
     say(args.caption, ts)
     path = os.path.join(
         args.results_dir,

@@ -121,7 +121,7 @@ class Engine:
                  complete: Optional[Callable] = None,
                  metrics=None, log_every: int = 0,
                  quantize_cache: bool = False,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.perf_counter):
         import jax
         import jax.numpy as jnp
 
@@ -263,7 +263,6 @@ class Engine:
 
     def _admit(self, handles: List[S.RequestHandle], now: float) -> None:
         import jax
-        import jax.numpy as jnp
         free = [i for i, s in enumerate(self.slots) if s is None]
         assert len(handles) <= len(free)
         groups = defaultdict(list)
@@ -292,12 +291,15 @@ class Engine:
                     int((1 - req.sampling.filter_thres) * v), 1)
                 self.top_p[i] = np.float32(req.sampling.top_p)
             try:
+                # same explicit-transfer discipline as step_once: the
+                # admission path's host<->device traffic is device_put/
+                # device_get at the site, never implicit conversion
                 first, self.cache = self._prefill_fn(t0, len(group))(
-                    self.params, self.cache, jnp.asarray(text),
-                    jnp.asarray(slots), jnp.asarray(self.rng[idx]),
-                    jnp.asarray(self.temp[idx]),
-                    jnp.asarray(self.topk_k[idx]),
-                    jnp.asarray(self.top_p[idx]))
+                    self.params, self.cache, jax.device_put(text),
+                    jax.device_put(slots), jax.device_put(self.rng[idx]),
+                    jax.device_put(self.temp[idx]),
+                    jax.device_put(self.topk_k[idx]),
+                    jax.device_put(self.top_p[idx]))
             except Exception as e:  # noqa: BLE001 — no-hangs contract
                 # the group's slots were never assigned (still None), so
                 # the pool stays consistent; the group's callers get a
@@ -305,7 +307,7 @@ class Engine:
                 for h in group:
                     self._error(h, now, f"prefill failed: {e!r}")
                 continue
-            first = np.asarray(first)
+            first = jax.device_get(first)
             for j, (i, h) in enumerate(zip(idx, group)):
                 self.pos[i] = t0
                 self.cur_tok[i] = first[j]
@@ -343,8 +345,15 @@ class Engine:
 
     def step_once(self) -> bool:
         """One engine iteration: expire, admit, decode one token on every
-        active slot, harvest. Returns True when any work happened."""
-        import jax.numpy as jnp
+        active slot, harvest. Returns True when any work happened.
+
+        Transfer discipline: the steady-state decode body below performs
+        its host<->device traffic through EXPLICIT jax.device_put /
+        device_get only, so tests can pin the contract with
+        ``analysis.guards.no_transfers()`` — an implicit transfer
+        sneaking into the hot loop fails tier-1, while the one known,
+        intentional round-trip stays visible at its site."""
+        import jax
         with self._lock:
             now = self.clock()
             if self._t_start is None:
@@ -378,11 +387,15 @@ class Engine:
                 if slot is not None:
                     slot.emitted.append(int(slot.cur_tok))
             nxt, self.cache = self._decode_fn(
-                self.params, self.cache, jnp.asarray(self.cur_tok),
-                jnp.asarray(self.pos), jnp.asarray(self.rng),
-                jnp.asarray(self.temp), jnp.asarray(self.topk_k),
-                jnp.asarray(self.top_p))
-            nxt = np.asarray(nxt)
+                self.params, self.cache, jax.device_put(self.cur_tok),
+                jax.device_put(self.pos), jax.device_put(self.rng),
+                jax.device_put(self.temp), jax.device_put(self.topk_k),
+                jax.device_put(self.top_p))
+            # jaxlint: disable=JL001 — the ONE intentional per-step
+            # round-trip: the host collects each slot's emitted token.
+            # ROADMAP (Serving, still open): keep cur_tok/pos on device
+            # and fetch emitted tokens asynchronously every K steps.
+            nxt = jax.device_get(nxt)
             for i, slot in enumerate(self.slots):
                 if slot is None:
                     continue

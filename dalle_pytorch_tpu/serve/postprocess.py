@@ -115,7 +115,7 @@ class PostProcessor:
                 handle, result = self._q.get(timeout=0.05)
             except queue.Empty:
                 continue
-            t0 = time.monotonic()
+            t0 = time.perf_counter()
             try:
                 img_seq = jnp.asarray(result.tokens)[None]
                 image = self._decode(self.vae_params,
@@ -142,7 +142,7 @@ class PostProcessor:
                     result.clip_score = float(np.asarray(score)[0])
                 self.decoded += 1
                 result.total_s = round(
-                    result.total_s + (time.monotonic() - t0), 6)
+                    result.total_s + (time.perf_counter() - t0), 6)
                 self._fulfill(handle, result)
             except Exception as e:      # noqa: BLE001 — no-hangs contract
                 result = S.Result(
@@ -150,7 +150,7 @@ class PostProcessor:
                     tokens=result.tokens, reason=f"postprocess: {e}",
                     queued_s=result.queued_s, decode_s=result.decode_s,
                     total_s=round(result.total_s
-                                  + (time.monotonic() - t0), 6))
+                                  + (time.perf_counter() - t0), 6))
                 self._fulfill(handle, result)
                 if self.metrics is not None:
                     self.metrics.event(**S.structured_event(

@@ -89,7 +89,7 @@ class Request:
     priority: int = 0                    # lower runs first
     deadline_s: Optional[float] = None   # relative to submit time
     request_id: int = -1                 # assigned by the queue
-    submit_t: float = 0.0                # monotonic, assigned by the queue
+    submit_t: float = 0.0                # perf_counter, set by the queue
 
     @property
     def deadline_t(self) -> Optional[float]:
@@ -162,7 +162,7 @@ class RequestQueue:
 
     def __init__(self, max_depth: int = 64,
                  max_prompt_len: Optional[int] = None,
-                 clock: Callable[[], float] = time.monotonic,
+                 clock: Callable[[], float] = time.perf_counter,
                  on_event=None):
         self.max_depth = int(max_depth)
         self.max_prompt_len = max_prompt_len

@@ -27,6 +27,8 @@ def structured_event(kind: str, **fields) -> dict:
     recovered" by grepping one shape. ``kind`` ∈ {bringup_retry,
     bringup_failure, rollback, diverged, step_checkpoint, preempt_signal,
     preempted, resume, prefetch_bad_record, prefetch_restart, ...}."""
+    # jaxlint: disable=JL007 — epoch timestamp in the event record, not
+    # duration math (durations here always come from perf_counter deltas)
     return {"time": time.time(), "event": "resilience", "kind": kind,
             **fields}
 
@@ -74,7 +76,7 @@ class MetricsLogger:
             "step": step, "loss": float(loss),
             f"{unit_name}_per_sec": round(rate * self.process_count, 2),
             f"{unit_name}_per_sec_per_chip": round(rate / n_dev, 2),
-            "time": time.time(),
+            "time": time.time(),  # jaxlint: disable=JL007 — epoch stamp
         }
         if epoch is not None:
             rec["epoch"] = epoch
@@ -92,7 +94,7 @@ class MetricsLogger:
 
     def event(self, **fields) -> None:
         """Free-form record (epoch summaries, checkpoint writes...)."""
-        rec = {"time": time.time(), **fields}
+        rec = {"time": time.time(), **fields}  # jaxlint: disable=JL007 — epoch stamp
         if self.path:
             with open(self.path, "a") as f:
                 f.write(json.dumps(rec) + "\n")

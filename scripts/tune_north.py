@@ -148,7 +148,7 @@ def main():
             bench.beat(f"point attn={attn} b={batch} chunk={chunk} "
                        f"remat={remat} rev={rev} {heads}x{dim_head} "
                        f"{bq}x{bk}")
-            t0 = time.time()
+            t0 = time.perf_counter()   # duration math — not wall-clock
             try:
                 step, params, opt_state, data, key = setup_train(
                     cfg, batch, mesh)
@@ -176,7 +176,7 @@ def main():
                    "flash_block_k": cfg.flash_block_k,
                    "tokens_sec_chip": round(tps, 1), "mfu": round(mfu, 4),
                    "loss": round(loss, 4),
-                   "setup_s": round(time.time() - t0 - dt, 1)}
+                   "setup_s": round(time.perf_counter() - t0 - dt, 1)}
             results.append(rec)
             print(json.dumps(rec), flush=True)
             # flush the merged record NOW: a later stall/wedge (or a kill)

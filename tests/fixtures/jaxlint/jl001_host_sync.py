@@ -1,0 +1,74 @@
+"""JL001 corpus: host syncs in traced code + round-trips on jit output.
+
+Parsed by tests/test_analysis.py, never executed. `# expect: JLxxx`
+marks a line jaxlint MUST flag; everything unmarked must stay clean.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_item(x):
+    return x.item()  # expect: JL001
+
+
+@jax.jit
+def bad_np_asarray(x):
+    return np.asarray(x)  # expect: JL001
+
+
+@jax.jit
+def bad_concretize(x):
+    return int(x)  # expect: JL001
+
+
+def helper_sync(x):
+    return x.tolist()  # expect: JL001
+
+
+@jax.jit
+def calls_helper(x):
+    # helper_sync is traced-reachable from here, so ITS sync is flagged
+    return helper_sync(x)
+
+
+def host_round_trip(params, x):
+    step = jax.jit(lambda p, v: p + v)
+    out = step(params, x)
+    return np.asarray(out)  # expect: JL001
+
+
+# --- must not flag -------------------------------------------------------
+
+@jax.jit
+def ok_jnp(x):
+    return jnp.asarray(x) + 1
+
+
+@jax.jit
+def ok_np_literal(x):
+    return x + np.asarray([1.0, 2.0])   # constant table, hoisted by jit
+
+
+def ok_host_code(x):
+    # not reachable from any traced function: host syncs are legal here
+    return np.asarray(x).item()
+
+
+def ok_sync_before_jit_bind(raw, x):
+    # flow-sensitive: y is plain host data when converted; it becomes a
+    # jit output only on the LAST line, after which nothing syncs it
+    step2 = jax.jit(lambda v: v * 2)
+    y = np.asarray(raw)
+    z = np.asarray(y)
+    y = step2(x)
+    return y, z
+
+
+def ok_rebound_to_host(params, x):
+    step3 = jax.jit(lambda p, v: p + v)
+    out = step3(params, x)
+    out = [1, 2, 3]              # rebound to host data
+    return np.asarray(out)

@@ -1,0 +1,47 @@
+"""JL002 corpus: python control flow on traced values."""
+
+import jax
+
+
+@jax.jit
+def bad_if(x):
+    if x > 0:  # expect: JL002
+        return x
+    return -x
+
+
+@jax.jit
+def bad_while(x):
+    while x < 10:  # expect: JL002
+        x = x * 2
+    return x
+
+
+# --- must not flag -------------------------------------------------------
+
+@jax.jit
+def ok_none_check(x, mask=None):
+    if mask is None:            # trace-time python fact
+        return x
+    return x * mask
+
+
+@jax.jit
+def ok_kwonly_config(x, *, causal=True):
+    if causal:                  # kwonly args are trace-time config
+        return x
+    return x + 1
+
+
+@jax.jit
+def ok_scalar_annotation(x, p: float = 0.5):
+    if p > 0:                   # scalar-annotated: python value
+        return x * p
+    return x
+
+
+@jax.jit
+def ok_static(x, n, *, _static=None):
+    if len(x) > 2:              # len() is a static shape fact
+        return x
+    return x + n

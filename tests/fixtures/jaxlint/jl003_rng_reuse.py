@@ -1,0 +1,55 @@
+"""JL003 corpus: PRNG key reuse across draws."""
+
+import jax
+
+
+def bad_straight_line(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))  # expect: JL003
+    return a + b
+
+
+def bad_loop_reuse(key):
+    out = []
+    for _ in range(3):
+        out.append(jax.random.normal(key, (2,)))  # expect: JL003
+    return out
+
+
+# --- must not flag -------------------------------------------------------
+
+def ok_split(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (2,))
+    b = jax.random.uniform(k2, (2,))
+    return a + b
+
+
+def ok_fold_in(key):
+    a = jax.random.normal(jax.random.fold_in(key, 0), (2,))
+    b = jax.random.uniform(jax.random.fold_in(key, 1), (2,))
+    return a + b
+
+
+def ok_loop_split(key):
+    out = []
+    for _ in range(3):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub, (2,)))
+    return out
+
+
+def ok_exclusive_branches(key, flag: bool):
+    if flag:
+        return jax.random.normal(key, (2,))
+    return jax.random.uniform(key, (2,))
+
+
+def ok_branch_rotation(key, flag: bool):
+    # every path re-derives the key, so the draw after the `if` is fresh
+    a = jax.random.normal(key, (2,))
+    if flag:
+        key = jax.random.fold_in(key, 1)
+    else:
+        key = jax.random.fold_in(key, 2)
+    return a + jax.random.normal(key, (2,))

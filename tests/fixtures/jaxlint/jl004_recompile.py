@@ -1,0 +1,37 @@
+"""JL004 corpus: jit constructions that retrace per call."""
+
+import jax
+
+
+def bad_jit_in_loop(fns, x):
+    outs = []
+    for fn in fns:
+        outs.append(jax.jit(fn)(x))  # expect: JL004
+    return outs
+
+
+def bad_lambda_then_jit(fns, x):
+    outs = []
+    for fn in fns:
+        # a lambda earlier in the statement must not hide the jit()
+        outs.append(((lambda v: v), jax.jit(fn)(x)))  # expect: JL004
+    return outs
+
+
+def bad_static_argnums(fn):
+    return jax.jit(fn, static_argnums=("name",))  # expect: JL004
+
+
+def bad_static_and_donated(fn):
+    return jax.jit(fn, static_argnums=(0,), donate_argnums=(0, 1))  # expect: JL004
+
+
+# --- must not flag -------------------------------------------------------
+
+def ok_constructed_outside(fn, xs):
+    step = jax.jit(fn)
+    return [step(x) for x in xs]
+
+
+def ok_static_ints(fn):
+    return jax.jit(fn, static_argnums=(0, 2), donate_argnums=(1,))

@@ -1,0 +1,34 @@
+"""JL005 corpus: jitted defs closing over loop variables."""
+
+import jax
+
+
+def bad_closure():
+    fns = []
+    for i in range(3):
+        @jax.jit
+        def f(x):  # expect: JL005
+            return x + i
+        fns.append(f)
+    return fns
+
+
+# --- must not flag -------------------------------------------------------
+
+def ok_default_bound():
+    fns = []
+    for i in range(3):
+        @jax.jit
+        def f(x, i=i):          # early-bound: each f sees its own i
+            return x + i
+        fns.append(f)
+    return fns
+
+
+def ok_not_jitted():
+    fns = []
+    for i in range(3):
+        def f(x):               # plain closure: python semantics, no jit
+            return x + i
+        fns.append(f)
+    return fns

@@ -1,0 +1,32 @@
+"""JL006 corpus: buffers referenced after donate_argnums donation."""
+
+import jax
+
+
+def tree_norm(t):
+    return t
+
+
+def apply_update(params, grads):
+    return params
+
+
+def bad_use_after_donate(params, grads):
+    step = jax.jit(apply_update, donate_argnums=(0,))
+    new_params = step(params, grads)
+    norm = tree_norm(params)  # expect: JL006
+    return new_params, norm
+
+
+# --- must not flag -------------------------------------------------------
+
+def ok_rebind(params, grads):
+    step = jax.jit(apply_update, donate_argnums=(0,))
+    params = step(params, grads)     # rebound to the NEW buffer
+    return tree_norm(params)
+
+
+def ok_not_donated(params, grads):
+    step = jax.jit(apply_update)
+    new_params = step(params, grads)
+    return new_params, tree_norm(params)

@@ -1,0 +1,25 @@
+"""JL007 corpus: time.time() in duration math vs waived epoch stamps."""
+
+import time
+
+
+def work():
+    pass
+
+
+def bad_duration():
+    t0 = time.time()  # expect: JL007
+    work()
+    return time.time() - t0  # expect: JL007
+
+
+# --- must not flag -------------------------------------------------------
+
+def ok_epoch_stamp():
+    return {"time": time.time()}  # jaxlint: disable=JL007 — epoch stamp
+
+
+def ok_perf_counter():
+    t0 = time.perf_counter()
+    work()
+    return time.perf_counter() - t0

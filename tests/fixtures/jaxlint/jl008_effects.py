@@ -1,0 +1,35 @@
+"""JL008 corpus: print/time side effects inside traced code."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def bad_print(x):
+    print("tracing", x)  # expect: JL008
+    return x + 1
+
+
+@jax.jit
+def bad_perf_counter(x):
+    t0 = time.perf_counter()  # expect: JL008
+    return x + t0
+
+
+@jax.jit
+def bad_wallclock(x):
+    return x + time.time()  # expect: JL008
+
+
+# --- must not flag -------------------------------------------------------
+
+def ok_host_print(x):
+    print("host-side logging is fine", x)
+    return x
+
+
+@jax.jit
+def ok_debug_print(x):
+    jax.debug.print("traced-safe: {}", x)
+    return x + 1

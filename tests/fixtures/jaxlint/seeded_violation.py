@@ -1,0 +1,21 @@
+"""The CI gate's canary: a deliberately seeded violation with NO waiver.
+
+.github/workflows/ci.yml runs jaxlint over this file and FAILS the build
+if the exit code is zero — proving the lint gate is actually live, not
+silently skipping files or rules. Do not "fix" this file."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def seeded_host_sync(x):
+    # a host sync inside a jitted decode step: the exact bug class the
+    # serving engine's one-compile contract exists to prevent
+    return x.item()
+
+
+def seeded_wallclock_duration():
+    t0 = time.time()
+    return time.time() - t0

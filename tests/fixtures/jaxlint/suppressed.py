@@ -1,0 +1,39 @@
+"""Suppression corpus: every violation here carries a waiver, so jaxlint
+must report ZERO findings for this file — in each supported form
+(trailing comment, standalone comment above, slug instead of id,
+comma list, `all`)."""
+
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def trailing_form(x):
+    return x.item()  # jaxlint: disable=JL001 — corpus: trailing waiver
+
+
+@jax.jit
+def line_above_form(x):
+    # jaxlint: disable=JL001 — corpus: waiver on its own line, then a
+    # second comment line before the statement it covers
+    return np.asarray(x)
+
+
+@jax.jit
+def slug_form(x):
+    if x > 0:  # jaxlint: disable=traced-branch — corpus: slug waiver
+        return x
+    return -x
+
+
+def comma_list_form(key):
+    t0 = time.time()  # jaxlint: disable=JL007,JL003 — corpus: list waiver
+    a = jax.random.normal(key, (2,))
+    b = jax.random.normal(key, (2,))  # jaxlint: disable=JL003 — corpus
+    return a + b, t0
+
+
+def all_form():
+    return time.time()  # jaxlint: disable=all — corpus: blanket waiver

@@ -106,8 +106,8 @@ def test_sparse_default_runs(key):
                        visual_image_size=32, visual_patch_size=4)
     assert cfg.sparse_attn is True          # the reference default
     params = C.clip_init(key, cfg)
-    text = jax.random.randint(key, (2, 32), 0, 50)
-    imgs = jax.random.uniform(key, (2, 32, 32, 3))
+    text = jax.random.randint(jax.random.fold_in(key, 1), (2, 32), 0, 50)
+    imgs = jax.random.uniform(jax.random.fold_in(key, 2), (2, 32, 32, 3))
     scores = C.clip_apply(params, text, imgs, cfg=cfg)
     assert np.isfinite(np.array(scores)).all()
 

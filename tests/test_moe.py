@@ -141,8 +141,8 @@ def test_dalle_moe_loss_and_generation(key):
                         text_seq_len=8, heads=4, dim_head=4, moe_experts=4)
     params = D.dalle_init(key, cfg)
     vae_params = V.vae_init(jax.random.PRNGKey(9), vcfg)
-    text = jax.random.randint(key, (2, 8), 0, 20)
-    image = jax.random.randint(key, (2, 16), 0, 12)
+    text = jax.random.randint(jax.random.fold_in(key, 1), (2, 8), 0, 20)
+    image = jax.random.randint(jax.random.fold_in(key, 2), (2, 16), 0, 12)
     loss = D.dalle_apply(params, text, image, cfg=cfg, return_loss=True)
     assert np.isfinite(float(loss))
 

@@ -359,8 +359,10 @@ class TestPipelineDALLE:
         key = jax.random.PRNGKey(1)
         # batch 8 over M=4 microbatches of 2, each sharded over dp=2
         batch = {
-            "text": jax.random.randint(key, (8, 8), 0, 20),
-            "image": jax.random.randint(key, (8, 16), 0, 12),
+            "text": jax.random.randint(jax.random.fold_in(key, 1),
+                                       (8, 8), 0, 20),
+            "image": jax.random.randint(jax.random.fold_in(key, 2),
+                                        (8, 16), 0, 12),
         }
         return cfg, params, batch, key
 
@@ -621,8 +623,10 @@ class TestSequenceParallelDALLE:
         params, opt_state = setup_sharded(params, opt, mesh)
         key = jax.random.PRNGKey(1)
         batch = {
-            "text": jax.random.randint(key, (4, 8), 0, 20),
-            "image": jax.random.randint(key, (4, 16), 0, 12),
+            "text": jax.random.randint(jax.random.fold_in(key, 1),
+                                       (4, 8), 0, 20),
+            "image": jax.random.randint(jax.random.fold_in(key, 2),
+                                        (4, 16), 0, 12),
         }
         dense = dalle_loss_fn(cfg)(params, batch, key)
 
@@ -677,8 +681,10 @@ class TestSequenceParallelMask:
         params = D.dalle_init(jax.random.PRNGKey(0), cfg)
         key = jax.random.PRNGKey(1)
         batch = {
-            "text": jax.random.randint(key, (4, 8), 0, 20),
-            "image": jax.random.randint(key, (4, 16), 0, 12),
+            "text": jax.random.randint(jax.random.fold_in(key, 1),
+                                       (4, 8), 0, 20),
+            "image": jax.random.randint(jax.random.fold_in(key, 2),
+                                        (4, 16), 0, 12),
             "mask": jnp.ones((4, 8), bool).at[:, 5:].set(False),
         }
         dense = dalle_loss_fn(cfg)(params, batch, key)
@@ -737,8 +743,10 @@ class TestGradAccumulation:
         mesh = make_mesh({"dp": 2, "sp": 4})
         params = D.dalle_init(jax.random.PRNGKey(0), cfg)
         key = jax.random.PRNGKey(1)
-        batch = {"text": jax.random.randint(key, (4, 8), 0, 20),
-                 "image": jax.random.randint(key, (4, 16), 0, 12)}
+        batch = {"text": jax.random.randint(jax.random.fold_in(key, 1),
+                                            (4, 8), 0, 20),
+                 "image": jax.random.randint(jax.random.fold_in(key, 2),
+                                             (4, 16), 0, 12)}
         dense = dalle_loss_fn(dataclasses.replace(cfg, loss_chunk=0))(
             params, batch, key)
         sp = sp_dalle_loss_fn(cfg, mesh, batch_axis="dp")(

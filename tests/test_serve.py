@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from dalle_pytorch_tpu.analysis import guards
 from dalle_pytorch_tpu.models import dalle as D
 from dalle_pytorch_tpu.models import vae as V
 from dalle_pytorch_tpu.serve import (DEADLINE_EXCEEDED, ERROR, OK,
@@ -77,18 +78,42 @@ class TestEquivalence:
         queue = RequestQueue(max_depth=8)
         engine = Engine(params, CFG, queue, num_slots=2)
         handles = [queue.submit(r) for r in REQS]
-        engine.run_until_idle()
+        # the shared guard (analysis.guards — same one bench_serve runs
+        # under): a recompiling decode step fails tier-1, not just bench
+        with guards.compile_count(lambda: engine.decode_traces, expect=1,
+                                  label="serve decode program"):
+            engine.run_until_idle()
 
         for h, ref in zip(handles, refs):
             res = h.result(timeout=5)
             assert res.status == OK
             np.testing.assert_array_equal(np.asarray(res.tokens), ref)
             assert res.total_s > 0 and res.decode_s > 0
-        assert engine.decode_traces == 1, \
-            "fixed-shape decode must compile exactly once"
         # prefill compiles per distinct (prompt_len, group_size), never
         # per request
         assert engine.prefill_traces <= len({len(r.codes) for r in REQS})
+
+    def test_steady_state_decode_is_transfer_clean(self, bundle):
+        """The steady-state decode step body runs under
+        ``guards.no_transfers()``: every host<->device crossing in the
+        hot loop is an explicit device_put/device_get at its site (the
+        per-step token fetch is the one known, ROADMAP-linked
+        allowance), and the guard must not perturb the token stream."""
+        params, vae_params = bundle
+        refs = [reference_tokens(params, vae_params, r)
+                for r in REQS[:2]]
+        queue = RequestQueue(max_depth=8)
+        engine = Engine(params, CFG, queue, num_slots=2)
+        handles = [queue.submit(r) for r in REQS[:2]]
+        engine.step_once()          # admission + first decode compile
+        assert engine.active_slots() == 2
+        with guards.no_transfers():
+            for _ in range(5):      # queue empty: pure decode steps
+                engine.step_once()
+        engine.run_until_idle()
+        for h, ref in zip(handles, refs):
+            np.testing.assert_array_equal(
+                np.asarray(h.result(timeout=5).tokens), ref)
 
     def test_join_midstream_does_not_perturb_running_slot(self, bundle):
         """A request admitted while another slot is mid-decode (the

@@ -74,7 +74,8 @@ def test_decode_codebook_override(key, params):
     """DALLE owns the tied codebook after training; decode must honor an
     external table (reference tying, dalle_pytorch.py:283)."""
     ids = jax.random.randint(key, (1, CFG.image_seq_len), 0, CFG.num_tokens)
-    alt = jax.random.normal(key, (CFG.num_tokens, CFG.codebook_dim))
+    alt = jax.random.normal(jax.random.fold_in(key, 1),
+                            (CFG.num_tokens, CFG.codebook_dim))
     a = decode(params, ids)
     b = decode(params, ids, codebook=alt)
     assert not np.allclose(np.array(a), np.array(b))
