@@ -1220,23 +1220,93 @@ def bench_moe(args):
     }
 
 
-def bench_serve(args):
-    """Serving-path bench: the continuous-batching engine
-    (dalle_pytorch_tpu/serve) under an offered-load sweep. For each load
-    point, requests arrive on a deterministic schedule (inter-arrival =
-    1/rps) while ONE engine drains them; the record carries throughput,
-    p50/p95 end-to-end latency, slot occupancy, and reject counts. The
-    engine (and its jit cache) is shared across load points, so
-    ``decode_compiles`` must read 1 for the whole sweep — the no-per-
-    request-recompile contract, asserted here, not just measured
-    (docs/SERVING.md methodology)."""
+def _serve_load_point(engine, queue, rps, n_req, prompt_len):
+    """One offered-load point: requests arrive on a deterministic
+    schedule (inter-arrival = 1/rps) while the engine drains them.
+    Returns the per-point record, including the host-round-trip
+    accounting the device-resident loop exists to improve: device_gets
+    (emit-ring harvests) per generated token and per fused decode step,
+    measured over THIS point's deltas."""
     import statistics as stats_mod
 
+    from dalle_pytorch_tpu.serve import QueueFull, Request, SamplingParams
+
+    base = {"offered_rps": rps, "requests": n_req}
+    occ0, steps0 = engine.occupancy_sum, engine.decode_steps
+    tok0, harv0 = engine.tokens_decoded, engine.harvests
+    completed, rejected = [], 0
+    t0 = time.perf_counter()
+    next_arrival, submitted = t0, 0
+    pending = []
+    while submitted < n_req or pending:
+        now = time.perf_counter()
+        while submitted < n_req and now >= next_arrival:
+            try:
+                pending.append(queue.submit(Request(
+                    codes=(1 + submitted % 7,) * prompt_len,
+                    seed=submitted, sampling=SamplingParams())))
+            except QueueFull:
+                rejected += 1       # structured shed — counted, typed
+            submitted += 1
+            next_arrival += 1.0 / rps
+        engine.step_once()
+        done = [h for h in pending if h.done()]
+        for h in done:
+            completed.append(h.result())
+            pending.remove(h)
+    # stop the clock at the LAST fulfillment: the post-completion
+    # pipeline flush below is dead chunks only (grows with K) and must
+    # not bias the K-sweep throughput comparison
+    wall = time.perf_counter() - t0
+    engine.run_until_idle()         # flush the in-flight chunk pipeline
+    lats = sorted(r.total_s for r in completed if r.ok)
+    n_ok = len(lats)
+    d_tok = engine.tokens_decoded - tok0
+    d_harv = engine.harvests - harv0
+    d_steps = engine.decode_steps - steps0
+    tokens_per_req = engine.cfg.seq_len - prompt_len
+    base.update({
+        "completed": n_ok, "rejected": rejected,
+        "throughput_imgs_per_s": round(n_ok / wall, 3),
+        "tokens_per_s": round(n_ok * tokens_per_req / wall, 1),
+        "p50_latency_ms": round(1e3 * stats_mod.median(lats), 1)
+        if lats else None,
+        "p95_latency_ms": round(
+            1e3 * lats[min(int(0.95 * n_ok), n_ok - 1)], 1)
+        if lats else None,
+        "wall_s": round(wall, 2),
+        # the before/after of the device-resident loop: with K-step
+        # chunks and >= 1 slot busy this is <= 1/K (one harvest per
+        # K*occupancy tokens), vs 1/occupancy for the old per-step fetch
+        "host_round_trips_per_token": round(d_harv / max(d_tok, 1), 6),
+        "round_trips_per_step": round(d_harv / max(d_steps, 1), 6),
+        # occupancy over THIS load point's steps, not the engine lifetime
+        "mean_occupancy": round((engine.occupancy_sum - occ0)
+                                / max(d_steps, 1), 3),
+    })
+    return base
+
+
+def bench_serve(args):
+    """Serving-path bench: the continuous-batching engine
+    (dalle_pytorch_tpu/serve) under an offered-load sweep, swept over the
+    fused-chunk size K (``--serve_chunks``). For each K a fresh engine
+    runs every load point; the record carries throughput, p50/p95
+    end-to-end latency, slot occupancy, reject counts, and
+    ``host_round_trips_per_token`` — the number the device-resident
+    decode loop exists to drive down (1/(K*occupancy) vs the old
+    per-step fetch's 1/occupancy). Contracts are asserted, not just
+    measured (docs/SERVING.md methodology): the decode program may
+    compile exactly ONCE per engine (shared guards.compile_count), and
+    the whole sweep runs under ``guards.no_transfers()`` — an implicit
+    host<->device transfer anywhere in the steady-state loop fails the
+    config with an ``"error"`` field, which CI's serve-perf smoke greps
+    for."""
     import jax
     import jax.numpy as jnp
 
     from dalle_pytorch_tpu.models import dalle as D
-    from dalle_pytorch_tpu.serve import QueueFull, Request, RequestQueue, \
+    from dalle_pytorch_tpu.serve import Request, RequestQueue, \
         SamplingParams
     from dalle_pytorch_tpu.serve.engine import Engine
 
@@ -1254,95 +1324,85 @@ def bench_serve(args):
         raise ValueError(f"--serve_loads must be comma-separated numbers, "
                          f"got {args.serve_loads!r}")
     if any(rps <= 0 for rps in loads):
-        # rps divides the inter-arrival gap below; 0 would ZeroDivide
+        # rps divides the inter-arrival gap; 0 would ZeroDivide
         # mid-sweep after the expensive warmup
         raise ValueError(f"--serve_loads entries must be > 0, got "
                          f"{args.serve_loads!r}")
-    # one queue/engine pair for the whole sweep: the decode program and
-    # the per-prompt-length prefill programs compile once, ever
-    queue = RequestQueue(max_depth=2 * num_slots)
-    engine = Engine(params, cfg, queue, num_slots=num_slots)
+    try:
+        chunk_sweep = [int(k) for k in args.serve_chunks.split(",")]
+    except ValueError:
+        raise ValueError(f"--serve_chunks must be comma-separated ints, "
+                         f"got {args.serve_chunks!r}")
+    if any(k < 1 for k in chunk_sweep):
+        raise ValueError(f"--serve_chunks entries must be >= 1, got "
+                         f"{args.serve_chunks!r}")
     prompt_len = min(4, cfg.text_seq_len)
-    tokens_per_req = cfg.seq_len - prompt_len
+    errors = []
 
-    _progress(f"serve: compiling prefill + slot-batched decode "
-              f"({num_slots} slots, seq {cfg.seq_len})")
-    # the whole bench — warmup AND sweep — runs under the shared
-    # compile-count guard (analysis.guards): the decode program may
-    # trace exactly once, at warmup. Non-raising mode: a violation
-    # lands in the JSON record below instead of killing the sweep.
-    with guards.compile_count(lambda: engine.decode_traces, expect=1,
-                              label="serve decode program",
-                              raise_on_violation=False) as decode_guard:
-        # warm the jit cache outside the timed region (same discipline
-        # as time_steps' warmup)
-        h = queue.submit(Request(codes=(1,) * prompt_len, seed=0,
-                                 sampling=SamplingParams()))
-        engine.run_until_idle()
-        h.result(timeout=60)
+    k_sweep = []
+    for k in chunk_sweep:
+        # one queue/engine pair per K for the whole load sweep: the
+        # fused decode program and the per-bucket prefill programs
+        # compile once, ever
+        queue = RequestQueue(max_depth=2 * num_slots)
+        engine = Engine(params, cfg, queue, num_slots=num_slots,
+                        chunk_steps=k)
+        _progress(f"serve: K={k} compiling bucketed prefill + fused "
+                  f"{k}-step decode ({num_slots} slots, seq {cfg.seq_len})")
+        with guards.compile_count(lambda: engine.decode_traces, expect=1,
+                                  label=f"serve decode program (K={k})",
+                                  raise_on_violation=False) as decode_guard:
+            # warm the jit cache outside the timed + transfer-guarded
+            # region (same discipline as time_steps' warmup)
+            h = queue.submit(Request(codes=(1,) * prompt_len, seed=0,
+                                     sampling=SamplingParams()))
+            engine.run_until_idle()
+            h.result(timeout=60)
 
-        results = []
-        for rps in loads:
-            base = {"offered_rps": rps, "requests": n_req}
-            occ0, steps0 = engine.occupancy_sum, engine.decode_steps
-            completed, rejected = [], 0
-            t0 = time.perf_counter()
-            next_arrival, submitted = t0, 0
-            pending = []
-            while submitted < n_req or pending:
-                now = time.perf_counter()
-                while submitted < n_req and now >= next_arrival:
-                    try:
-                        pending.append(queue.submit(Request(
-                            codes=(1 + submitted % 7,) * prompt_len,
-                            seed=submitted, sampling=SamplingParams())))
-                    except QueueFull:
-                        rejected += 1       # structured shed — counted, typed
-                    submitted += 1
-                    next_arrival += 1.0 / rps
-                engine.step_once()
-                done = [h for h in pending if h.done()]
-                for h in done:
-                    completed.append(h.result())
-                    pending.remove(h)
-            wall = time.perf_counter() - t0
-            lats = sorted(r.total_s for r in completed if r.ok)
-            n_ok = len(lats)
-            base.update({
-                "completed": n_ok, "rejected": rejected,
-                "throughput_imgs_per_s": round(n_ok / wall, 3),
-                "tokens_per_s": round(n_ok * tokens_per_req / wall, 1),
-                "p50_latency_ms": round(1e3 * stats_mod.median(lats), 1)
-                if lats else None,
-                "p95_latency_ms": round(
-                    1e3 * lats[min(int(0.95 * n_ok), n_ok - 1)], 1)
-                if lats else None,
-                "wall_s": round(wall, 2),
-            })
-            # occupancy over THIS load point's steps, not the engine lifetime
-            base["mean_occupancy"] = round(
-                (engine.occupancy_sum - occ0)
-                / max(engine.decode_steps - steps0, 1), 3)
-            results.append(base)
-            _progress(f"serve: rps={rps} done ({n_ok} ok, {rejected} "
-                      f"rejected, {base['wall_s']}s)")
+            results = []
+            # steady state is TRANSFER-CLEAN: decode state never leaves
+            # the device; the only host reads are the explicit emit-ring
+            # harvests the engine counts
+            with guards.no_transfers():
+                for rps in loads:
+                    point = _serve_load_point(engine, queue, rps, n_req,
+                                              prompt_len)
+                    results.append(point)
+                    _progress(f"serve: K={k} rps={rps} done "
+                              f"({point['completed']} ok, "
+                              f"{point['rejected']} rejected, "
+                              f"{point['wall_s']}s)")
+        snap = engine.stats()
+        entry = {
+            "chunk_steps": k, "results": results,
+            "decode_compiles": snap["decode_compiles"],
+            "prefill_compiles": snap["prefill_compiles"],
+            "host_round_trips_per_token":
+                snap["host_round_trips_per_token"],
+        }
+        if decode_guard.error is not None:
+            # the one-compile contract IS the point of the fixed-shape
+            # slot pool; a recompile mid-sweep is a correctness failure,
+            # not noise
+            entry["error"] = str(decode_guard.error)
+            errors.append(str(decode_guard.error))
+        k_sweep.append(entry)
 
-    snap = engine.stats()
+    best = k_sweep[-1]["results"][-1]
     record = {
-        "metric": "serve engine offered-load sweep (continuous batching)"
+        "metric": "serve engine offered-load sweep (device-resident "
+                  "fused-chunk decode)"
                   if not args.tiny else "tiny serve sweep",
-        "value": results[-1]["throughput_imgs_per_s"],
-        "unit": "imgs/sec at highest load", "vs_baseline": None,
+        "value": best["throughput_imgs_per_s"],
+        "unit": f"imgs/sec at highest load, K={chunk_sweep[-1]}",
+        "vs_baseline": None,
         "num_slots": num_slots, "seq_len": cfg.seq_len,
-        "prompt_len": prompt_len, "results": results,
-        "decode_compiles": snap["decode_compiles"],
-        "prefill_compiles": snap["prefill_compiles"],
+        "prompt_len": prompt_len, "chunk_sweep": chunk_sweep,
+        "k_sweep": k_sweep, "transfer_clean": True,
         "devices": len(jax.devices()), "backend": jax.default_backend(),
     }
-    if decode_guard.error is not None:
-        # the one-compile contract IS the point of the fixed-shape slot
-        # pool; a recompile mid-sweep is a correctness failure, not noise
-        record["error"] = str(decode_guard.error)
+    if errors:
+        record["error"] = "; ".join(errors)
     return record
 
 
@@ -1428,6 +1488,12 @@ def main():
                     help="bench_serve: comma list of offered loads "
                          "(requests/sec) — at least two points for the "
                          "latency/throughput curve")
+    ap.add_argument("--serve_chunks", default="1,8,32",
+                    help="bench_serve: comma list of fused-chunk sizes K "
+                         "(decode steps per device program / emitted "
+                         "tokens per host round-trip) — K=1 is the "
+                         "per-step-fetch baseline the device-resident "
+                         "loop is measured against")
     args = ap.parse_args()
     if args.gen_quant and args.no_gen:
         ap.error("--gen_quant needs the generate half; drop --no_gen")

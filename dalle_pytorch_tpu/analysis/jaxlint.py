@@ -485,12 +485,25 @@ def _check_traced_bodies(idx: _ModuleIndex, path: str,
                         f"while_loop or mark the argument static"))
 
 
+def _trace_time_compare(node: ast.Compare) -> bool:
+    """Compares that read python facts, not tracer values: identity
+    (`x is None`), and CONSTANT-key membership (`"k_scale" in cache` —
+    pytree STRUCTURE, fixed at trace time). Membership with a non-
+    constant left operand (`if x in xs:`) stays flagged: on a traced
+    array that is exactly the TracerBoolConversionError JL002 exists
+    to catch."""
+    if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        return True
+    return all(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops) \
+        and isinstance(node.left, ast.Constant)
+
+
 def _traced_names_in_test(test: ast.AST, params: Set[str]) -> Set[str]:
     """Parameter names whose VALUE the test branches on. `x is None`,
-    `isinstance(x, ...)`, `len(x)` and attribute access (config objects)
-    are trace-time python facts, not tracer reads."""
-    if isinstance(test, ast.Compare) and all(
-            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+    `isinstance(x, ...)`, `len(x)`, attribute access (config objects)
+    and constant-key membership (`"k" in cache`) are trace-time python
+    facts, not tracer reads."""
+    if isinstance(test, ast.Compare) and _trace_time_compare(test):
         return set()
     skip: Set[ast.AST] = set()
     for node in ast.walk(test):
@@ -502,8 +515,7 @@ def _traced_names_in_test(test: ast.AST, params: Set[str]) -> Set[str]:
         elif isinstance(node, ast.Attribute):
             for sub in ast.walk(node):
                 skip.add(sub)
-        elif isinstance(node, ast.Compare) and all(
-                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        elif isinstance(node, ast.Compare) and _trace_time_compare(node):
             for sub in ast.walk(node):
                 skip.add(sub)
     return {node.id for node in ast.walk(test)

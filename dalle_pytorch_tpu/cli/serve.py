@@ -52,6 +52,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_slots", type=int, default=4,
                    help="decode slot-pool size — the fixed batch the one "
                         "compiled decode program advances every step")
+    p.add_argument("--chunk_steps", type=int, default=8,
+                   help="decode steps fused per device program (K): the "
+                        "host harvests emitted tokens once per K steps "
+                        "instead of once per step, and a finishing "
+                        "request waits up to K-1 extra steps for its "
+                        "result — pick K against your latency deadline "
+                        "(docs/SERVING.md 'Choosing K')")
+    p.add_argument("--prefill_buckets", type=str, default="",
+                   help="comma list of prompt-length buckets admission "
+                        "pads up to (must end at text_seq_len); default "
+                        "= powers of two up to text_seq_len. One prefill "
+                        "compile per bucket, ever")
     p.add_argument("--queue_depth", type=int, default=64,
                    help="bounded admission queue; submissions past this "
                         "are rejected with a structured 429")
@@ -117,16 +129,25 @@ def main(argv=None):
     metrics = MetricsLogger(args.metrics or None) if args.metrics else None
 
     from dalle_pytorch_tpu.serve.server import InferenceServer, serve_http
+    buckets = None
+    if args.prefill_buckets:
+        try:
+            buckets = [int(b) for b in args.prefill_buckets.split(",")]
+        except ValueError:
+            raise SystemExit(f"--prefill_buckets must be comma-separated "
+                             f"ints, got {args.prefill_buckets!r}")
     server = InferenceServer(
         params, vae_params, cfg, num_slots=args.num_slots,
-        queue_depth=args.queue_depth,
+        queue_depth=args.queue_depth, chunk_steps=args.chunk_steps,
+        prefill_buckets=buckets,
         quantize_cache=args.quantize == "int8_kv",
         clip_params=clip_params, clip_cfg=clip_cfg, metrics=metrics,
         log_every=args.log_every, encode=vocab.encode,
         init_deadline_s=args.init_deadline_s,
         init_retries=args.init_retries).start()
     say(f"serving {dalle_path} on http://{args.host}:{args.port} "
-        f"({args.num_slots} slots, queue {args.queue_depth})")
+        f"({args.num_slots} slots, K={args.chunk_steps}, "
+        f"queue {args.queue_depth})")
     serve_http(server, args.host, args.port)
 
 

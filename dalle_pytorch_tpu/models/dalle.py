@@ -412,6 +412,51 @@ def top_p_filter(logits: Array, p: float) -> Array:
     return jnp.where(logits < thresh, core.neg_inf(logits.dtype), logits)
 
 
+def sample_per_slot(logits: Array, pred_pos: Array, keys: Array,
+                    temp: Array, topk_k: Array, top_p: Array,
+                    cfg: DALLEConfig) -> Array:
+    """Per-slot sampling: the traced-parameter form of ``generate_images``'s
+    ``sample`` — forbidden-position mask, temperature, top-k OR nucleus
+    filter, categorical — with every knob a (slots,) array instead of a
+    python constant, so the serve engine's one compiled program covers any
+    per-request mix (serve/engine.py holds the equivalence contract).
+
+    Value-identical to the one-shot path per slot: the top-k threshold is
+    the k-th largest logit (what ``lax.top_k(...)[..., -1:]`` returns)
+    read off a full descending sort so k can vary per slot; the nucleus
+    branch is ``top_p_filter``'s exact math with p broadcast per slot.
+    Both filters are computed every step (fixed shape) and selected per
+    slot; ``top_p > 0`` selects nucleus, exactly as the python-level
+    branch does in ``generate_images``. Per-slot draws go through
+    ``fold_in(key, pred_pos)`` — the one-shot sampler's key discipline —
+    and ``jax.random.categorical`` over one slot's (vocab,) row equals
+    the batch-1 call with the same key. Returns sampled ids with the
+    text-vocab offset removed for image positions, as ``generate_images``
+    stores them."""
+    forbidden = logits_mask(cfg)
+    lg = jnp.where(jnp.take(forbidden, pred_pos - 1, axis=0),
+                   core.neg_inf(logits.dtype), logits)
+    lg = lg / temp[:, None]
+
+    sorted_desc = jnp.flip(jnp.sort(lg, axis=-1), axis=-1)
+    kth = jnp.take_along_axis(sorted_desc, (topk_k - 1)[:, None], axis=-1)
+    by_k = jnp.where(lg < kth, core.neg_inf(lg.dtype), lg)
+
+    probs = jax.nn.softmax(sorted_desc.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < top_p[:, None]
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_desc,
+                               jnp.inf).astype(lg.dtype),
+                     axis=-1, keepdims=True)
+    by_p = jnp.where(lg < thresh, core.neg_inf(lg.dtype), lg)
+
+    lg = jnp.where((top_p > 0)[:, None], by_p, by_k)
+    folded = jax.vmap(jax.random.fold_in)(keys, pred_pos)
+    raw = jax.vmap(jax.random.categorical)(folded, lg)
+    is_image = pred_pos >= cfg.text_seq_len
+    return jnp.where(is_image, raw - cfg.num_text_tokens, raw)
+
+
 def generate_images(params: dict, vae_params: dict, text: Array, *,
                     cfg: DALLEConfig, rng: Array,
                     mask: Optional[Array] = None,

@@ -157,6 +157,9 @@ def _sparse_layout(cfg, total_len: int) -> Array:
     block = cfg.sparse_block
     padded = ((total_len + block - 1) // block) * block
     layout = sparse.token_layout_mask(padded, block, causal=cfg.causal)
+    # jaxlint: disable=JL001 — layout is host data built from static
+    # config only (no tracer flows in); this is trace-time constant
+    # construction, hoisted into the program once per compile
     return jnp.asarray(np.asarray(layout)[:total_len, :total_len])
 
 
@@ -224,6 +227,55 @@ def prefill(params: dict, x: Array, *, cfg, total_len: int,
     cache = init_cache(cfg, b, total_len, ks.dtype,
                        quantized=quantize_cache)
     return h_out, _store_rows(cache, ks, vs, 0)
+
+
+def decode_loop(params: dict, cur_tok: Array, pos: Array, active: Array,
+                cache: dict, *, cfg, key_mask: Array, steps: int,
+                embed_fn, sample_fn) -> Tuple[Array, Array, Array, dict,
+                                              Array]:
+    """Fuse ``steps`` decode steps into ONE device program: a ``lax.scan``
+    over ``decode_step`` that carries (cur_tok, pos, active, cache) as
+    device state and stacks each step's emitted token into an emit ring —
+    the serve engine's steady-state loop, where the host must not be in
+    the per-token path (one host round-trip per K tokens instead of one
+    per token; docs/SERVING.md).
+
+    cur_tok/pos: (b,) per-slot current token and position. active: (b,)
+    bool — a slot emits only while active; a slot whose position reaches
+    the cache end mid-loop deactivates itself and keeps computing into a
+    dead mask (parked at pos 0, rewriting its dead row — fixed shapes,
+    so the program never retraces) until the host's next harvest notices.
+    ``embed_fn(cur_tok, pos) -> (b, dim)`` and
+    ``sample_fn(h, pred_pos) -> (b,)`` are the model-level halves
+    (``models.dalle.decode_token_embed`` / ``to_logits`` + per-slot
+    sampling) so this ops layer stays model-agnostic.
+
+    Returns (cur_tok, pos, active, cache, emit_ring) with emit_ring
+    (b, steps) int32: slot b's tokens in step order, -1 where the slot
+    was inactive (the harvest sentinel — real tokens are >= 0, image ids
+    are stored offset-free exactly as ``generate_images`` emits them).
+    """
+    total_len = cache["k"].shape[3]
+
+    def one_step(carry, _):
+        cur_tok, pos, act, cache = carry
+        emit = jnp.where(act, cur_tok, -1)
+        x = embed_fn(cur_tok, pos)
+        h, cache = decode_step(params, x, pos, cache, cfg=cfg,
+                               key_mask=key_mask)
+        nxt = sample_fn(h, pos + 1)
+        pos = pos + 1
+        act = act & (pos < total_len)
+        # dead slots (finished, killed, or never admitted) park at
+        # (tok 0, pos 0): they rewrite their dead row 0 instead of
+        # scattering past the cache end, and emit the -1 sentinel
+        cur_tok = jnp.where(act, nxt, 0)
+        pos = jnp.where(act, pos, 0)
+        return (cur_tok, pos, act, cache), emit
+
+    (cur_tok, pos, active, cache), emits = lax.scan(
+        one_step, (cur_tok, pos, active, cache), None, length=steps)
+    return cur_tok, pos, active, cache, jnp.moveaxis(emits, 0, 1)
 
 
 def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,

@@ -8,7 +8,8 @@ the same discipline as ``resilience`` (utils/metrics.py note)."""
 from dalle_pytorch_tpu.serve.scheduler import (  # noqa: F401
     CANCELLED, DEADLINE_EXCEEDED, ERROR, OK, REJECTED, InvalidRequest,
     QueueClosed, QueueFull, Request, RequestHandle, RequestQueue, Result,
-    SamplingParams, ServeRejected)
+    SamplingParams, ServeRejected, bucket_for, group_by_bucket,
+    prefill_buckets)
 
 
 def __getattr__(name):

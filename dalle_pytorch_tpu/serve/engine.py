@@ -1,114 +1,103 @@
-"""Slot-pool continuous-batching decode engine.
+"""Slot-pool continuous-batching decode engine, device-resident.
 
 The one-shot path (``cli/gen_dalle.py`` -> ``models.dalle.generate_images``)
 pays full compile + prefill + ~1024 sequential decode steps PER REQUEST,
 with no batching across requests. This engine is the serving answer: a
 fixed ``[num_slots]`` decode batch compiled ONCE, where requests join and
-leave every step via masking (the slot-based continuous batching standard
-on TPU — PAPERS.md "Ragged Paged Attention", "Serving Gemma on Cloud
-TPU"):
+leave via masking (the slot-based continuous batching standard on TPU —
+PAPERS.md "Ragged Paged Attention", "Serving Gemma on Cloud TPU"), and —
+since one host round-trip per decode step is the dominant non-compute
+cost on a real chip — a steady-state loop the host is NOT in:
 
-  * the KV cache is allocated once for all slots
-    (``ops.decode.init_cache`` at batch = num_slots); a freed slot's stale
-    rows are dead by construction (the per-slot causal mask only reads
-    rows < that slot's position, and admission overwrites the whole slot
-    buffer);
-  * every decode step advances ALL slots one token through ONE jitted
-    program with per-slot positions (``ops.decode.decode_step`` with a
-    (num_slots,) ``pos`` vector), per-slot RNG keys, temperature, top-k
-    and top-p — idle slots compute masked garbage, the price of a fixed
-    shape and zero recompiles;
-  * admission batches pending prompts of the same length through one
-    ``ops.decode.prefill`` call and scatters the resulting KV rows into
-    the slot pool (compiled per (prompt_len, group_size) — bounded by the
-    distinct prompt lengths seen, NOT by request count).
+  * ALL per-slot decode state lives on device: ``cur_tok``, ``pos``, an
+    ``active`` mask, per-slot RNG keys, temperature, top-k and top-p,
+    plus the slot-pool KV cache (``ops.decode.init_cache`` at
+    batch = num_slots). The host keeps only request bookkeeping
+    (``_Slot``: handle, emitted-so-far, timestamps);
+  * the steady-state program is ``chunk_steps`` (K) decode steps FUSED
+    into one jitted ``lax.scan`` (``ops.decode.decode_loop``) that
+    writes each step's emitted tokens into a device-side
+    ``[num_slots, K]`` emit ring. The engine dispatches chunk programs
+    back-to-back and harvests a chunk's ring with a single
+    ``jax.device_get`` one chunk LATER (double-buffered: the blocking
+    get on chunk N overlaps the device computing chunk N+1), so ~1024
+    blocking syncs per request become ~1024/K overlapped ones;
+  * a slot that emits its last token deactivates itself INSIDE the fused
+    program (it keeps computing into a dead mask, parked at pos 0,
+    until the harvest notices) — finished-slot detection costs no
+    mid-chunk sync. Completion, and therefore the request's latency, is
+    timestamped at harvest (what the caller actually observes; a request
+    can wait up to K-1 dead steps plus one in-flight chunk for it —
+    docs/SERVING.md "Choosing K");
+  * admission pads prompts up to a small fixed set of BUCKET lengths
+    (``scheduler.prefill_buckets``) and always prefills a full
+    ``num_slots``-row group (unused rows scatter to a dropped
+    out-of-range slot index), so prefill compiles exactly once per
+    bucket for the engine's life — asserted by tests through
+    ``analysis.guards.compile_count``. Padding is causal-safe: cache
+    rows [0, t0) and the first sampled token depend only on positions
+    < t0, and every padded garbage row [t0, bucket) is overwritten by
+    the decode step for that position before any later step can attend
+    to it.
 
 Equivalence contract (tests/test_serve.py pins it): for the same params /
 prompt / seed / sampling knobs, a slot's emitted image tokens are
-IDENTICAL to ``generate_images`` at batch 1 — the engine reuses
-``decode_token_embed``/``logits_mask``/``to_logits`` and reimplements only
-the per-slot (traced-parameter) forms of the top-k/top-p filters, which
-are value-identical to ``top_k_filter``/``top_p_filter``. Per-slot
-sampling draws through ``fold_in(request_rng, position)`` exactly as
-``generate_images`` does; ``jax.random.categorical`` over one slot's
-(vocab,) row equals the batch-1 call with the same key.
+IDENTICAL to ``generate_images`` at batch 1 — for every chunk size K —
+because the fused loop reuses ``decode_token_embed`` / ``to_logits`` /
+``models.dalle.sample_per_slot`` (the per-slot traced-parameter form of
+the one-shot sampler's filters) with the same
+``fold_in(request_rng, position)`` key discipline, and K only changes
+where the host reads the stream, never what the device computes.
 
 Not supported per-request: classifier-free guidance (it doubles the
 stream per request; serve a guidance-dedicated engine instead) and padded
 prompt masks (requests carry unpadded codes, gen_dalle's default mode).
 
-The engine is deliberately single-threaded and drivable step-by-step
-(``step_once``) so tests and the bench can run it deterministically;
-``serve.server`` wraps it in a thread for live traffic.
+The engine is deliberately single-threaded and drivable iteration-by-
+iteration (``step_once`` = expire/admit/dispatch-one-chunk/harvest-one)
+so tests and the bench can run it deterministically; ``serve.server``
+wraps it in a thread for live traffic.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict
-from typing import Callable, Dict, List, Optional
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from dalle_pytorch_tpu.serve import scheduler as S
 
 
-def _sample_slots(logits, pred_pos, keys, temp, topk_k, top_p, cfg):
-    """Per-slot sampling: the traced-parameter form of ``generate_images``'s
-    ``sample`` (models/dalle.py) — forbidden-position mask, temperature,
-    top-k OR nucleus filter, categorical — with every knob a (slots,)
-    array instead of a python constant.
-
-    Value-identical to the one-shot path per slot: the top-k threshold is
-    the k-th largest logit (what ``lax.top_k(...)[..., -1:]`` returns)
-    read off a full descending sort so k can vary per slot; the nucleus
-    branch is ``top_p_filter``'s exact math with p broadcast per slot.
-    Both filters are computed every step (fixed shape) and selected per
-    slot. Returns sampled token ids with the text-vocab offset removed
-    for image positions, as ``generate_images`` stores them."""
-    import jax
-    import jax.numpy as jnp
-
-    from dalle_pytorch_tpu.models import dalle as D
-    from dalle_pytorch_tpu.ops import core
-
-    forbidden = D.logits_mask(cfg)
-    lg = jnp.where(jnp.take(forbidden, pred_pos - 1, axis=0),
-                   core.neg_inf(logits.dtype), logits)
-    lg = lg / temp[:, None]
-
-    sorted_desc = jnp.flip(jnp.sort(lg, axis=-1), axis=-1)
-    kth = jnp.take_along_axis(sorted_desc, (topk_k - 1)[:, None], axis=-1)
-    by_k = jnp.where(lg < kth, core.neg_inf(lg.dtype), lg)
-
-    probs = jax.nn.softmax(sorted_desc.astype(jnp.float32), axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep_sorted = (cum - probs) < top_p[:, None]
-    thresh = jnp.min(jnp.where(keep_sorted, sorted_desc,
-                               jnp.inf).astype(lg.dtype),
-                     axis=-1, keepdims=True)
-    by_p = jnp.where(lg < thresh, core.neg_inf(lg.dtype), lg)
-
-    lg = jnp.where((top_p > 0)[:, None], by_p, by_k)
-    folded = jax.vmap(jax.random.fold_in)(keys, pred_pos)
-    raw = jax.vmap(jax.random.categorical)(folded, lg)
-    is_image = pred_pos >= cfg.text_seq_len
-    return jnp.where(is_image, raw - cfg.num_text_tokens, raw)
-
-
 class _Slot:
-    """Host-side bookkeeping for one slot of the pool."""
+    """Host-side bookkeeping for one slot of the pool. Decode state
+    (position, current token) lives on device; the host only accumulates
+    harvested tokens against the handle."""
 
-    __slots__ = ("handle", "pos", "cur_tok", "emitted", "t_admit")
+    __slots__ = ("handle", "t0", "emitted", "t_admit")
 
-    def __init__(self, handle: S.RequestHandle, pos: int, cur_tok: int,
-                 t_admit: float):
+    def __init__(self, handle: S.RequestHandle, t0: int, t_admit: float):
         self.handle = handle
-        self.pos = pos
-        self.cur_tok = cur_tok
+        self.t0 = t0
         self.emitted: List[int] = []
         self.t_admit = t_admit
+
+
+class _Chunk:
+    """One in-flight fused-decode dispatch: the device-side emit ring and
+    post-chunk active mask (still futures until harvested), plus the
+    host's snapshot of which request occupied each slot at dispatch time
+    — a slot expired and re-admitted while the chunk is in flight must
+    not leak the old request's tokens into the new one."""
+
+    __slots__ = ("ring", "active", "owners")
+
+    def __init__(self, ring, active, owners):
+        self.ring = ring
+        self.active = active
+        self.owners = owners
 
 
 class Engine:
@@ -118,6 +107,8 @@ class Engine:
 
     def __init__(self, params: dict, cfg, queue: S.RequestQueue, *,
                  num_slots: int = 4,
+                 chunk_steps: int = 8,
+                 prefill_buckets: Optional[Sequence[int]] = None,
                  complete: Optional[Callable] = None,
                  metrics=None, log_every: int = 0,
                  quantize_cache: bool = False,
@@ -131,100 +122,157 @@ class Engine:
         self.cfg = cfg
         self.queue = queue
         self.num_slots = int(num_slots)
+        self.chunk_steps = int(chunk_steps)
+        if self.chunk_steps < 1:
+            raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
         self.complete = complete
         self.metrics = metrics
         self.log_every = int(log_every)
         self.quantize_cache = bool(quantize_cache)
         self.clock = clock
 
+        if prefill_buckets is None:
+            buckets = S.prefill_buckets(cfg.text_seq_len)
+        else:
+            buckets = tuple(sorted(set(int(b) for b in prefill_buckets)))
+            if not buckets or buckets[0] < 1 \
+                    or buckets[-1] != cfg.text_seq_len:
+                raise ValueError(
+                    f"prefill_buckets must be >= 1 and end at "
+                    f"text_seq_len ({cfg.text_seq_len}), got {buckets}")
+        self.buckets = buckets
+
         S_ = self.num_slots
         self.total_len = cfg.seq_len
-        # device state: the slot-pool KV cache lives on device for the
-        # engine's whole life; the small per-slot vectors round-trip the
-        # host every step (the host collects tokens anyway). Cache dtype
-        # follows the embedding table — the dtype that flows into qkv, so
-        # the admission scatter matches what prefill allocates (under
-        # bf16 params an f32 default would promote the whole decode carry)
+        # device state: EVERYTHING the steady-state loop touches stays on
+        # device between chunks — the KV cache, per-slot token/position/
+        # active mask, RNG keys and sampling knobs. The host writes them
+        # only through the admission/kill programs (device-side scatter),
+        # and reads only the emit ring, one explicit device_get per
+        # chunk. Cache dtype follows the embedding table — the dtype that
+        # flows into qkv, so the admission scatter matches what prefill
+        # allocates (under bf16 params an f32 default would promote the
+        # whole decode carry)
         self.cache = decode_ops.init_cache(
             cfg.transformer, S_, self.total_len,
             dtype=params["text_emb"]["w"].dtype,
             quantized=self.quantize_cache)
         self.key_mask = jnp.ones((S_, self.total_len), bool)
-        # host state (numpy; fixed shapes so the jit never retraces)
-        self.pos = np.zeros((S_,), np.int32)
-        self.cur_tok = np.zeros((S_,), np.int32)
-        self.rng = np.zeros((S_, 2), np.uint32)
-        self.temp = np.ones((S_,), np.float32)
-        self.topk_k = np.ones((S_,), np.int32)
-        self.top_p = np.zeros((S_,), np.float32)
+        self.cur_tok = jnp.zeros((S_,), jnp.int32)
+        self.pos = jnp.zeros((S_,), jnp.int32)
+        self.active = jnp.zeros((S_,), bool)
+        self.rng = jnp.zeros((S_, 2), jnp.uint32)
+        self.temp = jnp.ones((S_,), jnp.float32)
+        self.topk_k = jnp.ones((S_,), jnp.int32)
+        self.top_p = jnp.zeros((S_,), jnp.float32)
         self.slots: List[Optional[_Slot]] = [None] * S_
+        self._pending: deque = deque()   # dispatched, un-harvested chunks
 
         # counters (stats()/bench_serve read these)
         self.decode_traces = 0          # bumped only while TRACING: the
-        self.prefill_traces = 0         # fixed-shape contract keeps it at 1
-        self.decode_steps = 0
+        self.prefill_traces = 0         # fixed-shape contract keeps the
+        #                                 decode program at 1 and prefill
+        #                                 at 1 per bucket
+        self._prefill_trace_counts: Dict[int, int] = {}
+        self.decode_steps = 0           # fused steps dispatched (chunks*K)
+        self.harvests = 0               # emit-ring device_gets — the ONLY
+        #                                 host syncs in steady state
         self.tokens_decoded = 0
         self.completed = 0
         self.expired = 0
         self.occupancy_sum = 0
         self._t_start = None
+        self._last_log = 0
 
-        self._decode_fn = jax.jit(self._decode_impl)
+        # donating the cache lets XLA update the K/V buffers in place
+        # per chunk instead of copying them; CPU ignores donation with a
+        # warning, so only ask for it on a real accelerator
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=donate)
+        self._kill_fn = jax.jit(lambda active, keep: active & keep)
         self._prefill_fns: Dict = {}
         self._lock = threading.Lock()   # step_once is not reentrant
 
     # -- jitted programs ----------------------------------------------------
 
-    def _decode_impl(self, params, cache, cur_tok, pos, keys, temp,
+    def _decode_impl(self, params, cache, cur_tok, pos, active, keys, temp,
                      topk_k, top_p):
-        """One step for ALL slots: embed each slot's current token at its
-        own position, advance the stack once, sample each slot's next
-        token. Traced exactly once (fixed shapes) — the side-effecting
+        """The fused steady-state program: ``chunk_steps`` decode steps
+        for ALL slots in one ``lax.scan`` (``ops.decode.decode_loop``),
+        emitted tokens collected into the device-side (num_slots, K)
+        ring. Traced exactly once (fixed shapes) — the side-effecting
         counter below proves it."""
         self.decode_traces += 1
         from dalle_pytorch_tpu.models import dalle as D
         from dalle_pytorch_tpu.ops import decode as decode_ops
 
-        x = D.decode_token_embed(params, self.cfg, cur_tok, pos)
-        h, cache = decode_ops.decode_step(
-            params["transformer"], x, pos, cache,
-            cfg=self.cfg.transformer, key_mask=self.key_mask)
-        logits = D.to_logits(params, h)
-        nxt = _sample_slots(logits, pos + 1, keys, temp, topk_k, top_p,
-                            self.cfg)
-        return nxt, cache
+        def embed_fn(tok, p):
+            return D.decode_token_embed(params, self.cfg, tok, p)
 
-    def _prefill_fn(self, t0: int, n: int):
-        """Admission program for a group of ``n`` same-length prompts:
-        batched prefill + scatter of the KV rows into the slot pool +
-        each request's FIRST sampled token (position t0, key
-        ``fold_in(rng, t0)`` — ``generate_images``'s first_tok). Compiled
-        per (t0, n): bounded by distinct prompt lengths, not requests."""
+        def sample_fn(h, pred_pos):
+            logits = D.to_logits(params, h)
+            return D.sample_per_slot(logits, pred_pos, keys, temp,
+                                     topk_k, top_p, self.cfg)
+
+        return decode_ops.decode_loop(
+            params["transformer"], cur_tok, pos, active, cache,
+            cfg=self.cfg.transformer, key_mask=self.key_mask,
+            steps=self.chunk_steps, embed_fn=embed_fn, sample_fn=sample_fn)
+
+    def _prefill_fn(self, bucket: int):
+        """Admission program for one prompt-length BUCKET: batched prefill
+        of a full num_slots-row group (prompts padded to ``bucket``,
+        unused rows aimed at the dropped out-of-range slot index),
+        scatter of the KV rows into the slot pool, each request's FIRST
+        sampled token (position t0 = the TRUE prompt length, key
+        ``fold_in(rng, t0)`` — ``generate_images``'s first_tok), and the
+        device-side merge of the new slots' decode state. Compiled once
+        per bucket for the engine's life — group size is pinned at
+        num_slots, so no other shape can ever reach it."""
         import jax
         import jax.numpy as jnp
-        key = (t0, n)
-        if key in self._prefill_fns:
-            return self._prefill_fns[key]
+        if bucket in self._prefill_fns:
+            return self._prefill_fns[bucket]
 
-        def pre(params, cache, text, slots, keys, temp, topk_k, top_p):
+        def pre(params, cache, cur_tok, pos, active, rng, temp, topk_k,
+                top_p, text, lens, slots, n_seed, n_temp, n_topk, n_top_p):
             self.prefill_traces += 1
+            self._prefill_trace_counts[bucket] = \
+                self._prefill_trace_counts.get(bucket, 0) + 1
             from dalle_pytorch_tpu.models import dalle as D
             from dalle_pytorch_tpu.ops import decode as decode_ops
 
+            # seed -> key ON DEVICE (identical to the eager
+            # PRNGKey(seed) the one-shot path uses): the host ships
+            # plain int32 seeds, so admission stays free of implicit
+            # transfers under guards.no_transfers
+            n_rng = jax.vmap(jax.random.PRNGKey)(n_seed)
             tokens = D.embed_prompt(params, self.cfg, text)
             h, group = decode_ops.prefill(
                 params["transformer"], tokens, cfg=self.cfg.transformer,
                 total_len=self.total_len, prompt_mask=None,
                 quantize_cache=self.quantize_cache)
-            cache = {k: cache[k].at[:, slots].set(group[k]) for k in cache}
-            logits = D.to_logits(params, h[:, -1])
-            first = _sample_slots(logits,
-                                  jnp.full((text.shape[0],), t0, jnp.int32),
-                                  keys, temp, topk_k, top_p, self.cfg)
-            return first, cache
+            cache = {k: cache[k].at[:, slots].set(group[k], mode="drop")
+                     for k in cache}
+            # logits at each row's TRUE last prompt position: rows are
+            # padded to the bucket, but causality makes h[:, lens-1]
+            # identical to the unpadded prefill's last row
+            h_last = jnp.take_along_axis(
+                h, (lens - 1)[:, None, None], axis=1)[:, 0]
+            logits = D.to_logits(params, h_last)
+            first = D.sample_per_slot(logits, lens, n_rng, n_temp,
+                                      n_topk, n_top_p, self.cfg)
+            cur_tok = cur_tok.at[slots].set(first, mode="drop")
+            pos = pos.at[slots].set(lens, mode="drop")
+            active = active.at[slots].set(True, mode="drop")
+            rng = rng.at[slots].set(n_rng, mode="drop")
+            temp = temp.at[slots].set(n_temp, mode="drop")
+            topk_k = topk_k.at[slots].set(n_topk, mode="drop")
+            top_p = top_p.at[slots].set(n_top_p, mode="drop")
+            return cache, cur_tok, pos, active, rng, temp, topk_k, top_p
 
         fn = jax.jit(pre)
-        self._prefill_fns[key] = fn
+        self._prefill_fns[bucket] = fn
         return fn
 
     # -- request lifecycle --------------------------------------------------
@@ -265,7 +313,7 @@ class Engine:
         import jax
         free = [i for i, s in enumerate(self.slots) if s is None]
         assert len(handles) <= len(free)
-        groups = defaultdict(list)
+        valid = []
         for h in handles:
             # the server's queue validates at submit; a raw queue may
             # not — a prompt the pool can't hold must become a typed
@@ -275,68 +323,123 @@ class Engine:
                 self._error(h, now, f"invalid prompt length {n} "
                             f"(need 1..{self.cfg.text_seq_len})")
                 continue
-            groups[n].append(h)
-        for t0, group in groups.items():
+            valid.append(h)
+        for bucket, group in S.group_by_bucket(valid, self.buckets).items():
             idx = free[:len(group)]
             free = free[len(group):]
-            text = np.asarray([h.request.codes for h in group], np.int32)
-            slots = np.asarray(idx, np.int32)
-            for i, h in zip(idx, group):
+            G = self.num_slots
+            # fixed-shape group: prompts padded to the bucket, unused
+            # rows parked on slot index num_slots — out of range, so
+            # every scatter drops them (mode='drop' in the program)
+            text = np.zeros((G, bucket), np.int32)
+            lens = np.ones((G,), np.int32)
+            slots = np.full((G,), self.num_slots, np.int32)
+            n_seed = np.zeros((G,), np.int32)
+            n_temp = np.ones((G,), np.float32)
+            n_topk = np.ones((G,), np.int32)
+            n_top_p = np.zeros((G,), np.float32)
+            v = self.cfg.total_tokens
+            for j, h in enumerate(group):
                 req = h.request
-                v = self.cfg.total_tokens
-                self.rng[i] = np.asarray(
-                    jax.random.PRNGKey(req.seed), np.uint32)
-                self.temp[i] = np.float32(req.sampling.temperature)
-                self.topk_k[i] = max(
+                text[j, :len(req.codes)] = req.codes
+                lens[j] = len(req.codes)
+                slots[j] = idx[j]
+                # two's-complement truncation to int32: PRNGKey keeps
+                # only the low 32 bits under the default x64-off mode,
+                # so this is value-identical to PRNGKey(seed) eager
+                s = int(req.seed) & 0xFFFFFFFF
+                n_seed[j] = s - (1 << 32) if s >= (1 << 31) else s
+                n_temp[j] = np.float32(req.sampling.temperature)
+                n_topk[j] = max(
                     int((1 - req.sampling.filter_thres) * v), 1)
-                self.top_p[i] = np.float32(req.sampling.top_p)
+                n_top_p[j] = np.float32(req.sampling.top_p)
             try:
-                # same explicit-transfer discipline as step_once: the
-                # admission path's host<->device traffic is device_put/
-                # device_get at the site, never implicit conversion
-                first, self.cache = self._prefill_fn(t0, len(group))(
-                    self.params, self.cache, jax.device_put(text),
-                    jax.device_put(slots), jax.device_put(self.rng[idx]),
-                    jax.device_put(self.temp[idx]),
-                    jax.device_put(self.topk_k[idx]),
-                    jax.device_put(self.top_p[idx]))
+                # explicit-transfer discipline: the admission path's
+                # host->device traffic is device_put at the site, never
+                # implicit conversion (guards.no_transfers-clean)
+                outs = self._prefill_fn(bucket)(
+                    self.params, self.cache, self.cur_tok, self.pos,
+                    self.active, self.rng, self.temp, self.topk_k,
+                    self.top_p, jax.device_put(text),
+                    jax.device_put(lens), jax.device_put(slots),
+                    jax.device_put(n_seed), jax.device_put(n_temp),
+                    jax.device_put(n_topk), jax.device_put(n_top_p))
             except Exception as e:  # noqa: BLE001 — no-hangs contract
-                # the group's slots were never assigned (still None), so
+                # the group's slots were never assigned (still None) and
+                # the device state is rebound only on success below, so
                 # the pool stays consistent; the group's callers get a
                 # typed error instead of hanging on a dead loop
                 for h in group:
                     self._error(h, now, f"prefill failed: {e!r}")
                 continue
-            first = jax.device_get(first)
-            for j, (i, h) in enumerate(zip(idx, group)):
-                self.pos[i] = t0
-                self.cur_tok[i] = first[j]
-                self.slots[i] = _Slot(h, t0, int(first[j]), now)
+            (self.cache, self.cur_tok, self.pos, self.active, self.rng,
+             self.temp, self.topk_k, self.top_p) = outs
+            for i, h in zip(idx, group):
+                self.slots[i] = _Slot(h, len(h.request.codes), now)
 
-    def _harvest(self, now: float) -> None:
-        """Complete slots whose sequence is done; free them."""
-        for i, slot in enumerate(self.slots):
-            if slot is None or self.pos[i] < self.total_len:
+    # -- the fused-chunk pipeline -------------------------------------------
+
+    def _dispatch_chunk(self) -> None:
+        """Launch one K-step fused program from the current device state
+        and queue its emit ring for a later harvest. No host sync here:
+        the outputs are futures, and the device starts computing while
+        the host goes on to admit/harvest."""
+        outs = self._decode_fn(self.params, self.cache, self.cur_tok,
+                               self.pos, self.active, self.rng, self.temp,
+                               self.topk_k, self.top_p)
+        self.cur_tok, self.pos, self.active, self.cache, ring = outs
+        owners = [(i, s) for i, s in enumerate(self.slots)
+                  if s is not None]
+        self._pending.append(_Chunk(ring, self.active, owners))
+        self.decode_steps += self.chunk_steps
+
+    def _harvest_chunk(self) -> None:
+        """Fetch the OLDEST in-flight chunk's emit ring — the single
+        explicit host sync per K steps. Distributes each slot's tokens
+        to its owner at dispatch time and completes slots whose request
+        finished inside the chunk. Completion is timestamped HERE: a
+        request that emitted its last token mid-chunk becomes observable
+        to its caller only when the ring lands on the host, so harvest
+        time is the honest fulfillment time (docs/SERVING.md)."""
+        import jax
+        rec = self._pending.popleft()
+        ring, active_after = jax.device_get([rec.ring, rec.active])
+        self.harvests += 1
+        now = self.clock()
+        emitted = 0
+        for i, slot in rec.owners:
+            if slot.handle.done():
+                # expired/killed/errored since dispatch — its ring row
+                # is dead, and slot i may already belong to a newer
+                # request whose tokens start in a later chunk
                 continue
-            req = slot.handle.request
-            full = list(req.codes) + slot.emitted
-            img_seq = np.asarray(full[-self.cfg.image_seq_len:], np.int32)
-            # the completed text span (prompt + sampled text tokens) —
-            # generate_images' full[:, :text_seq_len], what CLIP rerank
-            # scores (postprocess.py)
-            text_seq = np.asarray(full[:self.cfg.text_seq_len], np.int32)
-            self.completed += 1
-            self._finish(slot.handle, S.Result(
-                status=S.OK, request_id=req.request_id, tokens=img_seq,
-                text_tokens=text_seq,
-                queued_s=round(slot.t_admit - req.submit_t, 6),
-                decode_s=round(now - slot.t_admit, 6),
-                total_s=round(now - req.submit_t, 6)))
-            self.slots[i] = None
-            # idle slots park at pos 0: they rewrite their dead row 0
-            # instead of scattering past the cache end
-            self.pos[i] = 0
-            self.cur_tok[i] = 0
+            row = ring[i]
+            toks = row[row >= 0]
+            slot.emitted.extend(int(t) for t in toks)
+            emitted += len(toks)
+            if self.slots[i] is slot and not bool(active_after[i]):
+                self._complete(i, slot, now)
+        self.tokens_decoded += emitted
+        self.occupancy_sum += emitted
+
+    def _complete(self, i: int, slot: _Slot, now: float) -> None:
+        """Fulfil a finished slot's request and free the slot (its device
+        state already parked itself inside the fused program)."""
+        req = slot.handle.request
+        full = list(req.codes) + slot.emitted
+        img_seq = np.asarray(full[-self.cfg.image_seq_len:], np.int32)
+        # the completed text span (prompt + sampled text tokens) —
+        # generate_images' full[:, :text_seq_len], what CLIP rerank
+        # scores (postprocess.py)
+        text_seq = np.asarray(full[:self.cfg.text_seq_len], np.int32)
+        self.completed += 1
+        self._finish(slot.handle, S.Result(
+            status=S.OK, request_id=req.request_id, tokens=img_seq,
+            text_tokens=text_seq,
+            queued_s=round(slot.t_admit - req.submit_t, 6),
+            decode_s=round(now - slot.t_admit, 6),
+            total_s=round(now - req.submit_t, 6)))
+        self.slots[i] = None
 
     # -- the loop -----------------------------------------------------------
 
@@ -344,23 +447,30 @@ class Engine:
         return sum(s is not None for s in self.slots)
 
     def step_once(self) -> bool:
-        """One engine iteration: expire, admit, decode one token on every
-        active slot, harvest. Returns True when any work happened.
+        """One engine iteration: expire, admit, dispatch ONE fused
+        K-step chunk, harvest the previous one. Returns True when any
+        work happened.
 
-        Transfer discipline: the steady-state decode body below performs
-        its host<->device traffic through EXPLICIT jax.device_put /
-        device_get only, so tests can pin the contract with
-        ``analysis.guards.no_transfers()`` — an implicit transfer
-        sneaking into the hot loop fails tier-1, while the one known,
-        intentional round-trip stays visible at its site."""
+        Transfer discipline: the steady-state loop performs NO implicit
+        host<->device traffic at all — per-slot decode state never
+        leaves the device, admission writes it through device_put +
+        jitted scatter, and the one host read is ``_harvest_chunk``'s
+        explicit ``jax.device_get`` of the emit ring, once per K steps
+        and overlapped with the next chunk's compute. Tests pin the
+        whole iteration (including a mid-stream join) under
+        ``analysis.guards.no_transfers()``."""
         import jax
         with self._lock:
             now = self.clock()
             if self._t_start is None:
                 self._t_start = now
 
-            # mid-decode deadlines: a slot past its deadline is cancelled
-            # before it spends another step
+            did = False
+            # mid-decode deadlines: chunk-boundary granularity — a slot
+            # past its deadline is cancelled before the next chunk is
+            # dispatched (its bit in the device mask is cleared, so the
+            # in-flight chunk's leftover tokens die with the owner check)
+            kill = []
             for i, slot in enumerate(self.slots):
                 if slot is None:
                     continue
@@ -368,8 +478,13 @@ class Engine:
                 if dt is not None and now > dt:
                     self._expire(slot.handle, now, where="decoding")
                     self.slots[i] = None
-                    self.pos[i] = 0
-                    self.cur_tok[i] = 0
+                    kill.append(i)
+            if kill:
+                keep = np.ones((self.num_slots,), bool)
+                keep[kill] = False
+                self.active = self._kill_fn(self.active,
+                                            jax.device_put(keep))
+                did = True
 
             free = self.num_slots - self.active_slots()
             ready, expired = self.queue.pop_ready(free, now)
@@ -377,50 +492,37 @@ class Engine:
                 self._expire(h, now, where="queued")
             if ready:
                 self._admit(ready, now)
+            did = did or bool(ready or expired)
 
-            n_active = self.active_slots()
-            if n_active == 0:
-                return bool(ready or expired)
+            dispatched = False
+            if self.active_slots() > 0:
+                self._dispatch_chunk()
+                dispatched = did = True
 
-            # every active slot emits its current token, then advances
-            for slot in self.slots:
-                if slot is not None:
-                    slot.emitted.append(int(slot.cur_tok))
-            nxt, self.cache = self._decode_fn(
-                self.params, self.cache, jax.device_put(self.cur_tok),
-                jax.device_put(self.pos), jax.device_put(self.rng),
-                jax.device_put(self.temp), jax.device_put(self.topk_k),
-                jax.device_put(self.top_p))
-            # jaxlint: disable=JL001 — the ONE intentional per-step
-            # round-trip: the host collects each slot's emitted token.
-            # ROADMAP (Serving, still open): keep cur_tok/pos on device
-            # and fetch emitted tokens asynchronously every K steps.
-            nxt = jax.device_get(nxt)
-            for i, slot in enumerate(self.slots):
-                if slot is None:
-                    continue
-                self.pos[i] += 1
-                self.cur_tok[i] = nxt[i]
-                slot.cur_tok = int(nxt[i])
-                slot.pos = int(self.pos[i])
-            self.decode_steps += 1
-            self.tokens_decoded += n_active
-            self.occupancy_sum += n_active
+            # double buffer: while dispatching, keep exactly one chunk
+            # in flight un-harvested — the device_get below blocks on
+            # chunk N while the device computes chunk N+1. Once nothing
+            # new is dispatched (pool drained), flush the pipeline.
+            target = 1 if dispatched else 0
+            while len(self._pending) > target:
+                self._harvest_chunk()
+                did = True
 
             if (self.metrics is not None and self.log_every
-                    and self.decode_steps % self.log_every == 0):
+                    and self.decode_steps - self._last_log
+                    >= self.log_every):
+                self._last_log = self.decode_steps
                 self.metrics.event(event="serve", **self.stats())
-
-            self._harvest(self.clock())
-            return True
+            return did
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> None:
-        """Drive until the queue is empty and every slot is free (tests,
-        bench). ``max_steps`` is a runaway guard, not a budget."""
+        """Drive until the queue is empty, every slot is free, and every
+        in-flight chunk is harvested (tests, bench). ``max_steps`` is a
+        runaway guard, not a budget."""
         for _ in range(max_steps):
             busy = self.step_once()
             if not busy and self.queue.depth() == 0 \
-                    and self.active_slots() == 0:
+                    and self.active_slots() == 0 and not self._pending:
                 return
         raise RuntimeError(f"engine did not go idle in {max_steps} steps")
 
@@ -450,14 +552,16 @@ class Engine:
                 stop.wait(idle_sleep_s)     # never hot-spin on a
                 continue                    # persistent fault
             if not busy and self.queue.depth() == 0 \
-                    and self.active_slots() == 0:
+                    and self.active_slots() == 0 and not self._pending:
                 stop.wait(idle_sleep_s)
 
     def _terminate_active(self, status: str, reason: str) -> int:
         """Fulfil every in-slot request with a typed terminal result and
         reset the pool to idle (slot state may be mid-update on the error
-        path, so the only consistent continuation is an empty pool).
+        path, and in-flight chunks may hold poisoned futures, so the only
+        consistent continuation is an empty pool and an empty pipeline).
         Returns the number terminated."""
+        import jax.numpy as jnp
         n = 0
         with self._lock:
             now = self.clock()
@@ -472,8 +576,10 @@ class Engine:
                     total_s=round(now - req.submit_t, 6)))
                 self.slots[i] = None
                 n += 1
-            self.pos[:] = 0
-            self.cur_tok[:] = 0
+            self._pending.clear()
+            self.cur_tok = jnp.zeros((self.num_slots,), jnp.int32)
+            self.pos = jnp.zeros((self.num_slots,), jnp.int32)
+            self.active = jnp.zeros((self.num_slots,), bool)
         return n
 
     def fail_active(self, reason: str) -> int:
@@ -489,6 +595,11 @@ class Engine:
 
     # -- observability ------------------------------------------------------
 
+    def prefill_trace_count(self, bucket: int) -> int:
+        """Traces of one bucket's prefill program (contract: <= 1 for the
+        engine's life; the guards.compile_count counter in tests)."""
+        return self._prefill_trace_counts.get(bucket, 0)
+
     def stats(self) -> dict:
         elapsed = None if self._t_start is None \
             else max(self.clock() - self._t_start, 1e-9)
@@ -496,6 +607,7 @@ class Engine:
             "queue_depth": self.queue.depth(),
             "active_slots": self.active_slots(),
             "num_slots": self.num_slots,
+            "chunk_steps": self.chunk_steps,
             "decode_steps": self.decode_steps,
             "tokens_decoded": self.tokens_decoded,
             "tokens_per_s": (round(self.tokens_decoded / elapsed, 2)
@@ -507,4 +619,8 @@ class Engine:
             "rejected": self.queue.rejected,
             "decode_compiles": self.decode_traces,
             "prefill_compiles": self.prefill_traces,
+            "prefill_buckets": list(self.buckets),
+            "harvests": self.harvests,
+            "host_round_trips_per_token": round(
+                self.harvests / max(self.tokens_decoded, 1), 6),
         }

@@ -24,7 +24,8 @@ import heapq
 import itertools
 import threading
 import time
-from typing import Callable, List, Optional, Tuple
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from dalle_pytorch_tpu.utils.metrics import structured_event
 
@@ -34,6 +35,47 @@ REJECTED = "rejected"
 DEADLINE_EXCEEDED = "deadline_exceeded"
 CANCELLED = "cancelled"
 ERROR = "error"
+
+
+def prefill_buckets(text_seq_len: int) -> Tuple[int, ...]:
+    """The default prompt-length buckets: powers of two up to (and always
+    including) ``text_seq_len``. Admission pads every prompt up to its
+    bucket, so the engine's prefill program compiles once per BUCKET for
+    the engine's life — a small fixed set — instead of once per distinct
+    prompt length seen (docs/SERVING.md "Prompt-length bucketing")."""
+    if text_seq_len < 1:
+        raise ValueError(f"text_seq_len must be >= 1, got {text_seq_len}")
+    out: List[int] = []
+    b = 1
+    while b < text_seq_len:
+        out.append(b)
+        b *= 2
+    out.append(text_seq_len)
+    return tuple(out)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket holding a length-``n`` prompt. ``buckets`` must be
+    sorted ascending; raises for a prompt no bucket can hold (callers
+    validate prompt length before bucketing)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds the largest bucket "
+                     f"{buckets[-1]}")
+
+
+def group_by_bucket(handles: Sequence["RequestHandle"],
+                    buckets: Sequence[int]
+                    ) -> Dict[int, List["RequestHandle"]]:
+    """Bucket-aware admission grouping: handles keyed by the bucket their
+    prompt pads up to, preserving pop order within a bucket. One prefill
+    dispatch per KEY — bounded by ``len(buckets)``, not by the distinct
+    prompt lengths seen."""
+    groups: Dict[int, List[RequestHandle]] = defaultdict(list)
+    for h in handles:
+        groups[bucket_for(len(h.request.codes), buckets)].append(h)
+    return groups
 
 
 class ServeRejected(RuntimeError):

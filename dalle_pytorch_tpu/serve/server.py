@@ -45,6 +45,8 @@ class InferenceServer:
 
     def __init__(self, params: dict, vae_params: dict, cfg, *,
                  num_slots: int = 4, queue_depth: int = 64,
+                 chunk_steps: int = 8,
+                 prefill_buckets=None,
                  quantize_cache: bool = False,
                  clip_params: Optional[dict] = None, clip_cfg=None,
                  decode_images: bool = True,
@@ -72,6 +74,7 @@ class InferenceServer:
                 on_fulfill=self._record_latency)
         self.engine = engine_mod.Engine(
             params, cfg, self.queue, num_slots=num_slots,
+            chunk_steps=chunk_steps, prefill_buckets=prefill_buckets,
             complete=self._on_decoded, metrics=metrics,
             log_every=log_every, quantize_cache=quantize_cache)
 

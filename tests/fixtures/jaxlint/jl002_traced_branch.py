@@ -45,3 +45,17 @@ def ok_static(x, n, *, _static=None):
     if len(x) > 2:              # len() is a static shape fact
         return x
     return x + n
+
+
+@jax.jit
+def ok_pytree_membership(x, cache):
+    if "k_scale" in cache:      # pytree STRUCTURE, fixed at trace time
+        return x + cache["k_scale"]
+    return x
+
+
+@jax.jit
+def bad_membership_on_traced(x, xs):
+    if x in xs:  # expect: JL002
+        return xs
+    return xs + x

@@ -1,13 +1,19 @@
-"""Serving subsystem tests (ISSUE 2 acceptance criteria).
+"""Serving subsystem tests (ISSUE 2 + ISSUE 4 acceptance criteria).
 
 The load-bearing one is equivalence: for the same params/prompt/seed/
 sampling knobs, the slot-batched engine's emitted image tokens are
 IDENTICAL to ``models.dalle.generate_images`` at batch 1 — including
 requests that join mid-stream while other slots are mid-decode, different
-prompt lengths, per-request temperature/top-k/top-p. Plus the structured-
+prompt lengths, per-request temperature/top-k/top-p, and EVERY fused
+chunk size K (the device-resident loop only changes where the host reads
+the stream, never what the device computes). Plus the structured-
 backpressure contract (queue-full and deadline-exceeded are typed results,
-no hangs, no silent drops) and the one-compile contract (the decode
-program traces exactly once across a multi-request run).
+no hangs, no silent drops) and the compile/transfer contracts: the fused
+decode program traces exactly once across a multi-request run, each
+prefill BUCKET traces exactly once for the engine's life, and the whole
+steady-state iteration — chunk dispatch, double-buffered emit-ring
+harvest, and a mid-stream join — holds under
+``analysis.guards.no_transfers()``.
 
 All CPU, tiny model (total_len 24) so the whole file stays cheap inside
 tier-1.
@@ -26,7 +32,8 @@ from dalle_pytorch_tpu.models import dalle as D
 from dalle_pytorch_tpu.models import vae as V
 from dalle_pytorch_tpu.serve import (DEADLINE_EXCEEDED, ERROR, OK,
                                      InvalidRequest, QueueClosed, QueueFull,
-                                     Request, RequestQueue, SamplingParams)
+                                     Request, RequestQueue, SamplingParams,
+                                     bucket_for, prefill_buckets)
 from dalle_pytorch_tpu.serve.engine import Engine
 
 VCFG = V.VAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
@@ -89,31 +96,102 @@ class TestEquivalence:
             assert res.status == OK
             np.testing.assert_array_equal(np.asarray(res.tokens), ref)
             assert res.total_s > 0 and res.decode_s > 0
-        # prefill compiles per distinct (prompt_len, group_size), never
-        # per request
-        assert engine.prefill_traces <= len({len(r.codes) for r in REQS})
+        # prefill compiles once per BUCKET admission padded into, never
+        # per request or per distinct prompt length
+        used = {bucket_for(len(r.codes), engine.buckets) for r in REQS}
+        assert engine.prefill_traces == len(used)
+        for b in used:
+            assert engine.prefill_trace_count(b) == 1
 
     def test_steady_state_decode_is_transfer_clean(self, bundle):
-        """The steady-state decode step body runs under
-        ``guards.no_transfers()``: every host<->device crossing in the
-        hot loop is an explicit device_put/device_get at its site (the
-        per-step token fetch is the one known, ROADMAP-linked
-        allowance), and the guard must not perturb the token stream."""
+        """Full K-step chunks — dispatch, double-buffered emit-ring
+        harvest, AND a mid-chunk slot join (admission prefill + the
+        device-side state merge) — run under ``guards.no_transfers()``:
+        per-slot decode state never leaves the device, every crossing is
+        an explicit device_put/device_get at its site (there is no
+        per-step allowance left to waive), and the guard must not
+        perturb the token stream. Each prefill bucket compiles exactly
+        once for the engine's LIFE (the guards.compile_count contract),
+        even though both buckets admit twice."""
         params, vae_params = bundle
         refs = [reference_tokens(params, vae_params, r)
                 for r in REQS[:2]]
         queue = RequestQueue(max_depth=8)
-        engine = Engine(params, CFG, queue, num_slots=2)
-        handles = [queue.submit(r) for r in REQS[:2]]
-        engine.step_once()          # admission + first decode compile
-        assert engine.active_slots() == 2
-        with guards.no_transfers():
-            for _ in range(5):      # queue empty: pure decode steps
-                engine.step_once()
+        engine = Engine(params, CFG, queue, num_slots=2, chunk_steps=4)
+        b0 = bucket_for(len(REQS[0].codes), engine.buckets)
+        b1 = bucket_for(len(REQS[1].codes), engine.buckets)
+        assert b0 != b1             # the join exercises a SECOND bucket
+        with guards.compile_count(
+                lambda: engine.prefill_trace_count(b0), expect=1,
+                label=f"prefill bucket {b0}"), \
+            guards.compile_count(
+                lambda: engine.prefill_trace_count(b1), expect=1,
+                label=f"prefill bucket {b1}"):
+            # warm run: compiles the fused decode program + both buckets
+            for r in REQS[:2]:
+                queue.submit(r)
+            engine.run_until_idle()
+            # steady state, transfer-guarded: a runs, b joins mid-stream
+            h_a = queue.submit(REQS[0])
+            engine.step_once()      # a admitted, chunk 1 in flight
+            with guards.no_transfers():
+                h_b = queue.submit(REQS[1])
+                engine.step_once()  # join + chunk 2 + harvest of chunk 1
+                engine.step_once()  # pure steady-state chunk
+            engine.run_until_idle()
+        np.testing.assert_array_equal(
+            np.asarray(h_a.result(timeout=5).tokens), refs[0])
+        np.testing.assert_array_equal(
+            np.asarray(h_b.result(timeout=5).tokens), refs[1])
+        assert engine.decode_traces == 1
+
+    @pytest.mark.parametrize("k", [1, 32])
+    def test_tokens_identical_across_chunk_sizes(self, bundle, k):
+        """The fused chunk size K must not change a single emitted token
+        — K only moves the host read boundary. K=1 degenerates to the
+        old per-step engine, K=32 covers a whole request in one chunk
+        (every slot finishes into the dead mask mid-chunk); the default
+        K=8 mid-chunk-boundary case is every other test in the file."""
+        params, vae_params = bundle
+        refs = [reference_tokens(params, vae_params, r) for r in REQS]
+        queue = RequestQueue(max_depth=8)
+        engine = Engine(params, CFG, queue, num_slots=2, chunk_steps=k)
+        handles = [queue.submit(r) for r in REQS]
         engine.run_until_idle()
         for h, ref in zip(handles, refs):
             np.testing.assert_array_equal(
                 np.asarray(h.result(timeout=5).tokens), ref)
+        assert engine.decode_traces == 1
+
+    def test_fulfillment_timestamped_at_harvest(self, bundle):
+        """A request that emits its last token mid-chunk becomes
+        observable only when the emit ring lands on the host (one chunk
+        later, double-buffered) — its recorded latency must be the
+        harvest-time, caller-observed number, not the in-chunk finish
+        (docs/SERVING.md 'Choosing K')."""
+        params, _ = bundle
+
+        class Clock:
+            t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        clock = Clock()
+        queue = RequestQueue(max_depth=4, clock=clock)
+        engine = Engine(params, CFG, queue, num_slots=1, chunk_steps=64,
+                        clock=clock)
+        h = queue.submit(REQS[0])       # submit_t = 0.0
+        engine.step_once()              # one 64-step chunk covers the
+        #                                 whole sequence: finished ON
+        #                                 DEVICE, but not yet harvested
+        assert not h.done()
+        clock.t = 5.0
+        engine.step_once()              # harvest lands the ring NOW
+        res = h.result(timeout=5)
+        assert res.status == OK
+        assert res.total_s == 5.0       # caller-observed harvest time
+        assert res.decode_s == 5.0
 
     def test_join_midstream_does_not_perturb_running_slot(self, bundle):
         """A request admitted while another slot is mid-decode (the
@@ -124,9 +202,9 @@ class TestEquivalence:
         ref_b = reference_tokens(params, vae_params, r_b)
 
         queue = RequestQueue(max_depth=8)
-        engine = Engine(params, CFG, queue, num_slots=2)
+        engine = Engine(params, CFG, queue, num_slots=2, chunk_steps=2)
         h_a = queue.submit(r_a)
-        for _ in range(5):                  # a is 5 tokens into decode
+        for _ in range(3):                  # a is ~6 tokens into decode
             engine.step_once()
         assert engine.active_slots() == 1
         h_b = queue.submit(r_b)             # b joins mid-stream
@@ -156,6 +234,51 @@ class TestEquivalence:
         engine.run_until_idle()
         np.testing.assert_array_equal(np.asarray(h.result(5).tokens),
                                       np.asarray(ref)[0])
+
+
+class TestBucketedPrefill:
+    """Prompt-length bucketing: admission pads prompts up to a small
+    fixed set of lengths so prefill compiles once per bucket, ever —
+    and padding must be invisible in the tokens (causality: rows and
+    first-token logits depend only on positions < the true length)."""
+
+    def test_default_buckets_are_powers_of_two_to_text_seq_len(self):
+        assert prefill_buckets(8) == (1, 2, 4, 8)
+        assert prefill_buckets(5) == (1, 2, 4, 5)
+        assert prefill_buckets(1) == (1,)
+        assert prefill_buckets(256)[-1] == 256
+
+    def test_bucket_for_picks_smallest_holding_bucket(self):
+        assert bucket_for(3, (1, 2, 4, 8)) == 4
+        assert bucket_for(4, (1, 2, 4, 8)) == 4
+        assert bucket_for(8, (1, 2, 4, 8)) == 8
+        with pytest.raises(ValueError, match="largest bucket"):
+            bucket_for(9, (1, 2, 4, 8))
+
+    def test_engine_rejects_buckets_not_ending_at_text_seq_len(self,
+                                                              bundle):
+        params, _ = bundle
+        with pytest.raises(ValueError, match="prefill_buckets"):
+            Engine(params, CFG, RequestQueue(max_depth=2), num_slots=1,
+                   prefill_buckets=(1, 2, 4))  # can't hold a full prompt
+
+    def test_custom_buckets_share_one_prefill_program(self, bundle):
+        """With a single bucket = text_seq_len, EVERY prompt length
+        admits through ONE prefill program — and stays token-identical
+        to the unpadded one-shot path."""
+        params, vae_params = bundle
+        refs = [reference_tokens(params, vae_params, r) for r in REQS[:2]]
+        queue = RequestQueue(max_depth=8)
+        engine = Engine(params, CFG, queue, num_slots=2,
+                        prefill_buckets=(CFG.text_seq_len,))
+        handles = [queue.submit(r) for r in REQS[:2]]
+        with guards.compile_count(
+                lambda: engine.prefill_traces, expect=1,
+                label="single-bucket prefill"):
+            engine.run_until_idle()
+        for h, ref in zip(handles, refs):
+            np.testing.assert_array_equal(
+                np.asarray(h.result(timeout=5).tokens), ref)
 
 
 class TestBackpressure:
