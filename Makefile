@@ -41,4 +41,4 @@ lint:            ## syntax check + jaxlint (the TPU-invariant AST rules)
 	    bench.py __graft_entry__.py
 	for f in scripts/*.sh; do bash -n $$f || exit 1; done
 	$(CPU_ENV) python -m dalle_pytorch_tpu.analysis.jaxlint \
-	    dalle_pytorch_tpu tests bench.py
+	    dalle_pytorch_tpu tests scripts bench.py

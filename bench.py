@@ -1287,21 +1287,106 @@ def _serve_load_point(engine, queue, rps, n_req, prompt_len):
     return base
 
 
+def _serve_kv_budget_compare(params, cfg, *, num_slots, page_size,
+                             min_requests=0, chunk_steps=8):
+    """Dense vs paged under the SAME simulated HBM page budget — the
+    number the paged KV subsystem exists for. The budget is what
+    ``dense_slots`` full-length dense caches occupy (in page units);
+    dense can never hold more than that many concurrent requests, while
+    the paged engine spends the same pages through block tables and
+    admits up to ``2 * dense_slots`` slots whose ragged positions share
+    the pool (mid-run exhaustion exercises the real eviction/requeue
+    path — evicted requests must still complete, token-exact by
+    determinism). Records peak concurrency, ``kv_hbm_bytes``,
+    ``pages_in_use_p95``, and eviction counts per mode, and ASSERTS the
+    paged engine sustained strictly more concurrent requests with every
+    request completing in both modes."""
+    from dalle_pytorch_tpu.serve import (Request, RequestQueue,
+                                         SamplingParams, kv_pool)
+    from dalle_pytorch_tpu.serve.engine import Engine
+
+    prompt_len = min(4, cfg.text_seq_len)
+    pages_per_seq = kv_pool.pages_for(cfg.seq_len, page_size)
+    dense_slots = max(2, num_slots // 2)
+    budget_pages = dense_slots * pages_per_seq
+    # enough offered load to overcommit the paged engine's slots (the
+    # comparison needs the pool, not the request count, to be the
+    # binding constraint); derived HERE from dense_slots so the
+    # overcommit guarantee can't drift from the slot split above
+    n_req = max(min_requests, 2 * dense_slots + 2)
+    out = {"page_size": page_size, "pages_per_seq": pages_per_seq,
+           "dense_slots": dense_slots, "paged_slots": 2 * dense_slots,
+           "budget_pages": budget_pages, "requests": n_req}
+    for mode in ("dense", "paged"):
+        queue = RequestQueue(max_depth=max(2 * n_req, 8))
+        if mode == "dense":
+            engine = Engine(params, cfg, queue, num_slots=dense_slots,
+                            chunk_steps=chunk_steps)
+        else:
+            # + 1: the reserved trash page is allocator bookkeeping, not
+            # usable KV budget
+            engine = Engine(params, cfg, queue, num_slots=2 * dense_slots,
+                            chunk_steps=chunk_steps, kv="paged",
+                            page_size=page_size,
+                            num_pages=budget_pages + 1)
+        handles = [queue.submit(Request(
+            codes=(1 + i % 7,) * prompt_len, seed=i,
+            sampling=SamplingParams())) for i in range(n_req)]
+        peak = 0
+        for _ in range(1_000_000):
+            busy = engine.step_once()
+            peak = max(peak, engine.active_slots())
+            if not busy and engine.idle():
+                break
+        ok = sum(h.result(timeout=60).status == "ok" for h in handles)
+        stats = engine.stats()
+        out[mode] = {
+            "num_slots": engine.num_slots,
+            "completed": ok,
+            "max_concurrency": peak,
+            "kv_hbm_bytes": stats["kv_hbm_bytes"],
+        }
+        if mode == "paged":
+            out[mode].update({
+                "pages_in_use_p95": stats["pages_in_use_p95"],
+                "pages_peak": stats["pages_peak"],
+                "evicted": stats["evicted"],
+                "requeued": stats["requeued"],
+            })
+    if out["dense"]["completed"] != n_req \
+            or out["paged"]["completed"] != n_req:
+        raise AssertionError(
+            f"kv budget compare: not every request completed "
+            f"(dense {out['dense']['completed']}/{n_req}, paged "
+            f"{out['paged']['completed']}/{n_req})")
+    if out["paged"]["max_concurrency"] <= out["dense"]["max_concurrency"]:
+        raise AssertionError(
+            f"paged engine did not sustain more concurrency than dense "
+            f"under the same page budget: paged "
+            f"{out['paged']['max_concurrency']} vs dense "
+            f"{out['dense']['max_concurrency']}")
+    return out
+
+
 def bench_serve(args):
     """Serving-path bench: the continuous-batching engine
     (dalle_pytorch_tpu/serve) under an offered-load sweep, swept over the
-    fused-chunk size K (``--serve_chunks``). For each K a fresh engine
-    runs every load point; the record carries throughput, p50/p95
-    end-to-end latency, slot occupancy, reject counts, and
-    ``host_round_trips_per_token`` — the number the device-resident
-    decode loop exists to drive down (1/(K*occupancy) vs the old
-    per-step fetch's 1/occupancy). Contracts are asserted, not just
-    measured (docs/SERVING.md methodology): the decode program may
-    compile exactly ONCE per engine (shared guards.compile_count), and
-    the whole sweep runs under ``guards.no_transfers()`` — an implicit
+    fused-chunk size K (``--serve_chunks``) with the KV layout picked by
+    ``--serve_kv`` (dense slot cache, or the paged block-pool — fully
+    provisioned here so the K-sweep contracts are layout-independent).
+    For each K a fresh engine runs every load point; the record carries
+    throughput, p50/p95 end-to-end latency, slot occupancy, reject
+    counts, and ``host_round_trips_per_token`` — the number the
+    device-resident decode loop exists to drive down (1/(K*occupancy) vs
+    the old per-step fetch's 1/occupancy). Contracts are asserted, not
+    just measured (docs/SERVING.md methodology): the decode program may
+    compile exactly ONCE per engine (shared guards.compile_count), the
+    whole sweep runs under ``guards.no_transfers()`` — an implicit
     host<->device transfer anywhere in the steady-state loop fails the
     config with an ``"error"`` field, which CI's serve-perf smoke greps
-    for."""
+    for — and the ``kv_budget_compare`` sub-record asserts the paged
+    engine sustains MORE concurrent requests than dense under the same
+    simulated HBM page budget (``_serve_kv_budget_compare``)."""
     import jax
     import jax.numpy as jnp
 
@@ -1338,6 +1423,10 @@ def bench_serve(args):
                          f"{args.serve_chunks!r}")
     prompt_len = min(4, cfg.text_seq_len)
     errors = []
+    kv = args.serve_kv
+    # default page size: divide the tiny seq exactly so the budget
+    # comparison compares equal KV bytes, 16 rows on the real config
+    page_size = args.serve_page_size or (8 if args.tiny else 16)
 
     k_sweep = []
     for k in chunk_sweep:
@@ -1346,9 +1435,11 @@ def bench_serve(args):
         # compile once, ever
         queue = RequestQueue(max_depth=2 * num_slots)
         engine = Engine(params, cfg, queue, num_slots=num_slots,
-                        chunk_steps=k)
+                        chunk_steps=k, kv=kv,
+                        page_size=page_size if kv == "paged" else 0)
         _progress(f"serve: K={k} compiling bucketed prefill + fused "
-                  f"{k}-step decode ({num_slots} slots, seq {cfg.seq_len})")
+                  f"{k}-step decode ({num_slots} slots, kv={kv}, "
+                  f"seq {cfg.seq_len})")
         with guards.compile_count(lambda: engine.decode_traces, expect=1,
                                   label=f"serve decode program (K={k})",
                                   raise_on_violation=False) as decode_guard:
@@ -1388,6 +1479,17 @@ def bench_serve(args):
             errors.append(str(decode_guard.error))
         k_sweep.append(entry)
 
+    _progress("serve: dense-vs-paged same-budget concurrency comparison")
+    try:
+        kv_compare = _serve_kv_budget_compare(
+            params, cfg, num_slots=num_slots, page_size=page_size,
+            min_requests=args.serve_requests)
+    except Exception as e:  # noqa: BLE001 — a wedged compare engine or
+        # bad page math must land in the structured "error" field the
+        # serve-perf CI leg greps, not torch the whole bench_all JSON
+        kv_compare = {"error": f"{type(e).__name__}: {e}"}
+        errors.append(str(e))
+
     best = k_sweep[-1]["results"][-1]
     record = {
         "metric": "serve engine offered-load sweep (device-resident "
@@ -1398,7 +1500,8 @@ def bench_serve(args):
         "vs_baseline": None,
         "num_slots": num_slots, "seq_len": cfg.seq_len,
         "prompt_len": prompt_len, "chunk_sweep": chunk_sweep,
-        "k_sweep": k_sweep, "transfer_clean": True,
+        "kv": kv, "k_sweep": k_sweep, "transfer_clean": True,
+        "kv_budget_compare": kv_compare,
         "devices": len(jax.devices()), "backend": jax.default_backend(),
     }
     if errors:
@@ -1494,6 +1597,16 @@ def main():
                          "tokens per host round-trip) — K=1 is the "
                          "per-step-fetch baseline the device-resident "
                          "loop is measured against")
+    ap.add_argument("--serve_kv", default="dense",
+                    choices=["dense", "paged"],
+                    help="bench_serve: KV layout for the K-sweep engine "
+                         "(the dense-vs-paged budget comparison always "
+                         "runs; CI's serve-perf matrix runs one leg per "
+                         "layout)")
+    ap.add_argument("--serve_page_size", type=int, default=0,
+                    help="bench_serve: KV page size for paged engines "
+                         "(0 = 8 rows under --tiny so pages divide the "
+                         "tiny seq exactly, else 16)")
     args = ap.parse_args()
     if args.gen_quant and args.no_gen:
         ap.error("--gen_quant needs the generate half; drop --no_gen")

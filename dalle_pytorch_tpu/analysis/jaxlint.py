@@ -68,6 +68,10 @@ RULES: Dict[str, Tuple[str, str]] = {
     "JL008": ("effect-in-jit",
               "print/time.* side effect inside traced code — runs at "
               "trace time only (or burns a callback into the program)"),
+    "JL009": ("cond-pred-sync",
+              "lax.cond/switch/while_loop dispatched eagerly on a jitted "
+              "program's output — the predicate implies a hidden host "
+              "round-trip per call (per iteration for while_loop)"),
 }
 
 # Wrappers whose function-valued argument is traced by JAX. Used to mark
@@ -592,6 +596,74 @@ def _check_sync_on_jit_output(idx: _ModuleIndex, path: str,
                     f"decode step)"))
 
 
+def _check_eager_lax_control(idx: _ModuleIndex, path: str,
+                             findings: List[Finding]) -> None:
+    """JL009: ``lax.cond``/``lax.switch``/``lax.while_loop`` dispatched
+    EAGERLY — outside any traced region — on operands derived from a
+    jitted program's output. Inside jit these are free; eagerly, the
+    dispatch is not transfer-clean (the predicate/carry round-trips with
+    the host — measurably so under ``jax.transfer_guard("disallow")``),
+    and ``while_loop`` pays it once per ITERATION. The fix is to wrap
+    the control flow in jit, or branch in python on genuinely host data.
+    Same flow-ordered jit-output tracking as JL001's round-trip half:
+    a name rebound to host data between the jitted call and the control
+    op stops being flagged."""
+    # which positional argument carries device data into the eager op:
+    # cond/switch take the predicate/index first; while_loop's cond_fun
+    # re-evaluates against the carry (arg 2) every iteration
+    ctl = {"cond": 0, "switch": 0, "while_loop": 2}
+    for fn in idx.functions:
+        if fn in idx.trace_roots:
+            continue
+        events: List[Tuple] = []
+        for node in _walk_no_nested_fns(fn):
+            if isinstance(node, ast.Assign):
+                kind = "jitbind" if isinstance(node.value, ast.Call) \
+                    and _last(node.value.func) in idx.jitted_names \
+                    else "bind"
+                for tgt in node.targets:
+                    els = tgt.elts if isinstance(tgt, (ast.Tuple,
+                                                       ast.List)) \
+                        else [tgt]
+                    for el in els:
+                        if isinstance(el, ast.Name):
+                            events.append((node.lineno, 1, el.col_offset,
+                                           kind, el.id, False))
+            elif isinstance(node, ast.Call) and _last(node.func) in ctl:
+                op = _last(node.func)
+                pos = ctl[op]
+                if pos >= len(node.args):
+                    continue
+                arg = node.args[pos]
+                names = frozenset(n.id for n in ast.walk(arg)
+                                  if isinstance(n, ast.Name))
+                # a jitted call INSIDE the operand expression is a device
+                # value regardless of any binding flow
+                direct = any(isinstance(c, ast.Call)
+                             and _last(c.func) in idx.jitted_names
+                             for c in ast.walk(arg))
+                events.append((node.lineno, 0, node.col_offset,
+                               "ctl:" + op, names, direct))
+        events.sort(key=lambda e: (e[0], e[1], e[2]))
+        jit_outputs: Set[str] = set()
+        for lineno, _, col, kind, payload, direct in events:
+            if kind == "jitbind":
+                jit_outputs.add(payload)
+            elif kind == "bind":
+                jit_outputs.discard(payload)
+            elif direct or (payload & jit_outputs):
+                op = kind[4:]
+                cost = ("its cond_fun syncs with the host every "
+                        "iteration" if op == "while_loop"
+                        else "the predicate forces a host round-trip "
+                             "per call")
+                findings.append(Finding(
+                    "JL009", path, lineno, col,
+                    f"eager lax.{op} on a jitted program's output — "
+                    f"{cost}; wrap the control flow in jit or branch "
+                    f"in python on host data"))
+
+
 def _check_rng_reuse(idx: _ModuleIndex, path: str,
                      findings: List[Finding]) -> None:
     """JL003: straight-line reuse of a PRNG key by two draws, and reuse
@@ -888,6 +960,7 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
                     for fn in idx.trace_roots]
     _check_traced_bodies(idx, path, findings)
     _check_sync_on_jit_output(idx, path, findings)
+    _check_eager_lax_control(idx, path, findings)
     _check_rng_reuse(idx, path, findings)
     _check_recompile_hazards(idx, path, tree, findings)
     _check_loop_closures(idx, path, tree, findings)

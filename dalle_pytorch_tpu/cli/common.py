@@ -251,6 +251,27 @@ def add_common_args(parser: argparse.ArgumentParser,
     parser.add_argument("--init_retries", type=int, default=3,
                         help="bring-up attempts under --init_deadline_s "
                              "before surfacing a structured failure")
+    parser.add_argument("--guard_transfers", action="store_true",
+                        help="wrap every train-step body in analysis."
+                             "guards.no_transfers(): an implicit host<->"
+                             "device transfer in the hot path raises at "
+                             "the offending call instead of silently "
+                             "stalling the chip each step (explicit "
+                             "device_put/device_get still pass). The CI "
+                             "train smoke runs with this on — the same "
+                             "transfer discipline the serve engine is "
+                             "pinned to (docs/STATIC_ANALYSIS.md)")
+
+
+def step_rng(key, step: int):
+    """``fold_in(key, step)`` with the step counter shipped as an
+    EXPLICIT device transfer. Value-identical to ``fold_in(key, step)``
+    on a python int (fold_in folds the uint32 of the operand either
+    way), but eager fold_in on an int is an IMPLICIT host->device
+    transfer — the one thing ``--guard_transfers`` exists to catch —
+    so the per-step RNG derivation spells its transfer at the site,
+    like every other crossing in the guarded step body."""
+    return jax.random.fold_in(key, jax.device_put(np.uint32(step)))
 
 
 def resolve_schedule(args, steps_per_epoch: int = 0, start_epoch: int = 0,
@@ -375,7 +396,10 @@ def make_ema(args, params, resume_path: str = ""):
 
     # donate the old EMA: it is dead after `ema = update(ema, params)`,
     # and without donation every step transiently holds two f32 copies
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    from dalle_pytorch_tpu.parallel._compat import donate_if_accelerator
+    donate = donate_if_accelerator(0)
+
+    @functools.partial(jax.jit, donate_argnums=donate)
     def update(e, p):
         return jax.tree.map(
             lambda a, b: d * a + (1.0 - d) * b.astype(jnp.float32), e, p)
@@ -445,6 +469,10 @@ def run_supervised_loop(args, *, sup, metrics, profiler, dataset, plan,
     from dalle_pytorch_tpu.data import prefetch
     from dalle_pytorch_tpu.resilience import Preempted
 
+    guard_transfers = getattr(args, "guard_transfers", False)
+    if guard_transfers:
+        from dalle_pytorch_tpu.analysis import guards
+
     start_epoch = state.epoch
     skip0 = plan["skip_batches"] if plan else 0
     mid_meta = plan["meta"] if (plan and plan["mid_epoch"]) else {}
@@ -473,7 +501,20 @@ def run_supervised_loop(args, *, sup, metrics, profiler, dataset, plan,
             for item in state.pf:
                 gs = state.global_step
                 profiler.maybe_start(gs)
-                loss, payload = train_step(item, state)
+                if guard_transfers:
+                    # the ROADMAP's no_transfers-around-the-train-step
+                    # item: the step body must spell every host<->device
+                    # crossing as an explicit device_put at the site
+                    # (shard_batch, step_rng, the CLIs' batch loaders) —
+                    # an implicit one raises HERE, naming the call,
+                    # instead of stalling the chip silently every step.
+                    # The loss fetch (float(loss) below) stays OUTSIDE
+                    # the guard: it is the loop's one intentional
+                    # per-step host read
+                    with guards.no_transfers():
+                        loss, payload = train_step(item, state)
+                else:
+                    loss, payload = train_step(item, state)
                 profiler.maybe_stop(gs)
                 lv = float(loss)
                 if sup.check_step(gs, lv) == sup.ROLLBACK:

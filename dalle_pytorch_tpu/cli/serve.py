@@ -64,6 +64,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "pads up to (must end at text_seq_len); default "
                         "= powers of two up to text_seq_len. One prefill "
                         "compile per bucket, ever")
+    p.add_argument("--kv", choices=("dense", "paged"), default="dense",
+                   help="KV-cache layout: 'dense' reserves num_slots x "
+                        "seq_len rows up front; 'paged' shares a page "
+                        "pool through per-slot block tables so HBM "
+                        "residency tracks actual positions — more "
+                        "concurrency per byte, with typed page "
+                        "backpressure (docs/SERVING.md 'Paged KV')")
+    p.add_argument("--page_size", type=int, default=0,
+                   help="rows per KV page (paged mode; 0 = default 16). "
+                        "Smaller pages waste fewer rows per request but "
+                        "widen the block tables")
+    p.add_argument("--num_pages", type=int, default=0,
+                   help="physical pages in the pool incl. the reserved "
+                        "trash page (paged mode; 0 = fully provisioned: "
+                        "num_slots x ceil(seq_len/page_size) + 1, i.e. "
+                        "no overcommit). Smaller = overcommit: admission "
+                        "defers on page pressure and mid-decode "
+                        "exhaustion evicts the lowest-priority request "
+                        "back to the queue")
     p.add_argument("--queue_depth", type=int, default=64,
                    help="bounded admission queue; submissions past this "
                         "are rejected with a structured 429")
@@ -141,13 +160,14 @@ def main(argv=None):
         queue_depth=args.queue_depth, chunk_steps=args.chunk_steps,
         prefill_buckets=buckets,
         quantize_cache=args.quantize == "int8_kv",
+        kv=args.kv, page_size=args.page_size, num_pages=args.num_pages,
         clip_params=clip_params, clip_cfg=clip_cfg, metrics=metrics,
         log_every=args.log_every, encode=vocab.encode,
         init_deadline_s=args.init_deadline_s,
         init_retries=args.init_retries).start()
     say(f"serving {dalle_path} on http://{args.host}:{args.port} "
         f"({args.num_slots} slots, K={args.chunk_steps}, "
-        f"queue {args.queue_depth})")
+        f"kv={args.kv}, queue {args.queue_depth})")
     serve_http(server, args.host, args.port)
 
 

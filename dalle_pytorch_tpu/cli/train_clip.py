@@ -30,7 +30,7 @@ from dalle_pytorch_tpu.cli.common import (LoopState, add_common_args,
                                           plan_resume, resolve_schedule,
                                           restore_rollback,
                                           run_supervised_loop, say,
-                                          setup_run)
+                                          setup_run, step_rng)
 from dalle_pytorch_tpu.data import load_image_batch
 from dalle_pytorch_tpu.models import clip as C
 from dalle_pytorch_tpu.parallel import make_train_step, shard_batch
@@ -158,7 +158,7 @@ def main(argv=None):
         batch = sup.pre_step(state.global_step, batch)
         params, opt_state, loss = step(
             params, opt_state, batch,
-            jax.random.fold_in(key, state.global_step))
+            step_rng(key, state.global_step))
         if ema is not None:
             ema = ema_update(ema, params)
         return loss, None

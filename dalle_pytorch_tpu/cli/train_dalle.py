@@ -43,7 +43,7 @@ from dalle_pytorch_tpu.cli.common import (LoopState, add_common_args,
                                           plan_resume, resolve_schedule,
                                           restore_rollback,
                                           run_supervised_loop, say,
-                                          setup_run)
+                                          setup_run, step_rng)
 from dalle_pytorch_tpu.data import load_image_batch, save_image_grid
 from dalle_pytorch_tpu.models import dalle as D
 from dalle_pytorch_tpu.models import vae as V
@@ -302,13 +302,17 @@ def main(argv=None):
 
     def train_step(hosted, state):
         nonlocal params, opt_state, ema
-        image_ids = tokenize(hosted["images"])
+        # explicit device_put on the host-decoded pixel batch: the VAE
+        # tokenizer jit must not rely on an implicit transfer (the body
+        # runs under --guard_transfers; shard_batch and step_rng are
+        # already explicit)
+        image_ids = tokenize(jax.device_put(hosted["images"]))
         batch = shard_batch(mesh, {"text": hosted["text"],
                                    "image": image_ids})
         batch = sup.pre_step(state.global_step, batch)
         params, opt_state, loss = step(
             params, opt_state, batch,
-            jax.random.fold_in(key, state.global_step))
+            step_rng(key, state.global_step))
         if ema is not None:
             ema = ema_update(ema, params)
         return loss, batch["text"]

@@ -37,7 +37,7 @@ from dalle_pytorch_tpu.cli.common import (LoopState, add_common_args,
                                           plan_resume, resolve_schedule,
                                           restore_rollback,
                                           run_supervised_loop, say,
-                                          setup_run)
+                                          setup_run, step_rng)
 from dalle_pytorch_tpu.data import ImageFolderDataset, save_image_grid, \
     shard_for_host
 from dalle_pytorch_tpu.models import vae as V
@@ -95,7 +95,10 @@ def make_step(cfg: V.VAEConfig, optimizer, clip: float,
         huber = jnp.mean(jnp.where(d < 1.0, 0.5 * d * d, d - 0.5))
         return huber + jnp.mean(jnp.square(imgs - recon))
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    from dalle_pytorch_tpu.parallel._compat import donate_if_accelerator
+    donate = donate_if_accelerator(0, 1)
+
+    @functools.partial(jax.jit, donate_argnums=donate)
     def step(params, opt_state, batch, rng):
         batch = dict(batch)
         # optional traced update scale (resilience LR re-warm) — for Adam
@@ -217,12 +220,15 @@ def main(argv=None):
 
     def train_step(images, state):
         nonlocal params, opt_state, ema
+        # every host->device crossing is explicit (shard_batch's
+        # device_put, the device_put'd temperature scalar, step_rng) so
+        # the body runs clean under --guard_transfers
         batch = shard_batch(mesh, {"images": images})
-        batch["temperature"] = jnp.float32(temperature)
+        batch["temperature"] = jax.device_put(np.float32(temperature))
         batch = sup.pre_step(state.global_step, batch)
         params, opt_state, loss = step(
             params, opt_state, batch,
-            jax.random.fold_in(key, state.global_step))
+            step_rng(key, state.global_step))
         if ema is not None:
             ema = ema_update(ema, params)
         return loss, batch

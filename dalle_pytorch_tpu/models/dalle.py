@@ -491,7 +491,12 @@ def generate_images(params: dict, vae_params: dict, text: Array, *,
     (ops.decode.init_cache) — halves the cache's share of per-token HBM
     reads (bench.decode_roofline_ms_per_token quantifies it; the term
     dominates at batch > 1). Composes with ``quantize_for_decode``
-    (int8 weights) for the full int8 decode path. Accuracy: the int8
+    (int8 weights) for the full int8 decode path, and with the serving
+    engine's PAGED KV layout (serve/kv_pool.py): the int8 page pool
+    carries the same per-row scales per page, quantizes through the
+    same ``_quantize_rows``, and obeys the identical error contract —
+    int8 halves the bytes per page exactly as it halves them per dense
+    row, so the two HBM levers multiply. Accuracy: the int8
     rows plus the scale-cast-to-score-dtype under bf16 compound to a
     ~1% relative attention-output error bound per layer (see
     ops.decode.init_cache); tests/test_quant.py's 2% end-to-end parity

@@ -289,6 +289,21 @@ def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,
 
     Returns (h_out (b, dim), updated cache).
     """
+    h_out, ks, vs = _decode_step_math(params, x_tok, pos, cache, cfg=cfg,
+                                      key_mask=key_mask)
+    return h_out, _store_rows(cache, ks, vs, pos)
+
+
+def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
+                      *, cfg, key_mask: Array
+                      ) -> Tuple[Array, Array, Array]:
+    """The read half of ``decode_step``: attention over the cached rows
+    plus self, WITHOUT the cache write-back. Returns (h_out (b, dim),
+    new ks, new vs (depth, b, heads, 1, dh)) so the two cache layouts —
+    the dense slot cache (``_store_rows``) and the paged page pool
+    (``_store_rows_paged``) — share one definition of the math and can
+    never diverge on what a step computes (``decode_step_paged`` is the
+    paged writer)."""
     from dalle_pytorch_tpu.ops import transformer as T
     depth, b, heads, total_len, dh = cache["k"].shape
     sparse_flags = jnp.asarray(cfg.sparse_pattern)
@@ -368,4 +383,143 @@ def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,
     carry, (ks, vs) = lax.scan(body, carry0, xs)
     h_out = (carry[0] + carry[1]) * 0.5 if cfg.reversible else carry
 
-    return h_out[:, 0, :], _store_rows(cache, ks, vs, pos)
+    return h_out[:, 0, :], ks, vs
+
+
+# ---------------------------------------------------------------------------
+# paged KV: block-table gather / scatter over a shared page pool
+# ---------------------------------------------------------------------------
+#
+# The serve engine's dense slot cache reserves num_slots x total_len rows of
+# HBM whether or not a slot is anywhere near total_len. The paged layout
+# (PAPERS.md "Ragged Paged Attention"; serve/kv_pool.py is the allocator)
+# stores K/V in a shared pool of fixed-size PAGES, (depth, num_pages,
+# heads, page_size, dim_head), and gives each slot a small int32 block
+# table mapping logical page j -> physical page id. Requests at different
+# positions then share one physical budget: a slot 10 tokens into its
+# sequence holds ceil(11/page_size) pages, not total_len rows.
+#
+# ``paged_view`` gathers a slot-major dense view through the block tables,
+# so the attention math downstream of it is LITERALLY ``_decode_step_math``
+# — row j of the view is position j, making paged-vs-dense token equality
+# hold by construction. The gather materializes the per-step read (same
+# bytes a dense step reads); the HBM win is *residency* — the pool can be
+# far smaller than num_slots x total_len. A Pallas ragged-paged-attention
+# kernel that consumes the block table directly (never materializing the
+# view) is the chip-side follow-up; this layout is what it would consume.
+
+
+def paged_view(pool: dict, block_tables: Array, total_len: int) -> dict:
+    """Dense per-slot view of the page pool: pool (depth, P, heads,
+    page_size, dh) gathered through block_tables (b, max_pages) into
+    (depth, b, heads, total_len, dh) — logical row j reads physical page
+    ``block_tables[i, j // page_size]`` at offset ``j % page_size``.
+    Unmapped table entries point at the reserved trash page 0; their rows
+    are never attended (causality masks every row >= the slot's pos,
+    and the allocator maps pages ahead of pos). Scales gather the same
+    way for the int8 pool (kv_pool.init_page_pool)."""
+
+    def rows(buf):
+        g = jnp.take(buf, block_tables, axis=1)   # (d, b, mp, heads, ps, dh)
+        g = jnp.moveaxis(g, 2, 3)                 # (d, b, heads, mp, ps, dh)
+        g = g.reshape(g.shape[:3] + (g.shape[3] * g.shape[4],) + g.shape[5:])
+        return g[:, :, :, :total_len, :]
+
+    def scales(buf):
+        g = jnp.take(buf, block_tables, axis=1)   # (d, b, mp, heads, ps)
+        g = jnp.moveaxis(g, 2, 3)                 # (d, b, heads, mp, ps)
+        return g.reshape(g.shape[:3] + (-1,))[:, :, :, :total_len]
+
+    out = {"k": rows(pool["k"]), "v": rows(pool["v"])}
+    if "k_scale" in pool:
+        out["k_scale"] = scales(pool["k_scale"])
+        out["v_scale"] = scales(pool["v_scale"])
+    return out
+
+
+def _store_rows_paged(pool: dict, ks: Array, vs: Array, pos: Array,
+                      block_tables: Array, active: Array) -> dict:
+    """Paged scatter twin of ``_store_rows_per_slot``: slot i's single new
+    K/V row (depth, b, heads, 1, dh) lands in physical page
+    ``block_tables[i, pos[i] // page_size]`` at offset ``pos[i] %
+    page_size``. INACTIVE slots are redirected to the reserved trash page
+    0: a dead slot parks at pos 0, and its block-table entry 0 may map a
+    physical page the allocator has already handed to a NEWER request —
+    writing through it would corrupt live rows (the dense layout never
+    has this hazard because a slot owns its rows forever). Same
+    quantization contract as the dense writers (one write definition per
+    layout)."""
+    ps = pool["k"].shape[3]
+    b = pos.shape[0]
+    bidx = jnp.arange(b)
+    page = jnp.where(active, block_tables[bidx, pos // ps], 0)
+    off = jnp.where(active, pos % ps, 0)
+
+    def put_rows(buf, rows):
+        # buf (depth, P, heads, ps, dh); advanced indices at dims 1 and 3
+        # are non-adjacent, so the update value is (b, depth, heads, dh)
+        return buf.at[:, page, :, off, :].set(
+            jnp.moveaxis(rows[:, :, :, 0, :], 0, 1))
+
+    def put_scales(buf, sc):
+        # buf (depth, P, heads, ps); value (b, depth, heads)
+        return buf.at[:, page, :, off].set(
+            jnp.moveaxis(sc[:, :, :, 0], 0, 1))
+
+    if "k_scale" in pool:
+        kq, ksc = _quantize_rows(ks)
+        vq, vsc = _quantize_rows(vs)
+        return {"k": put_rows(pool["k"], kq),
+                "v": put_rows(pool["v"], vq),
+                "k_scale": put_scales(pool["k_scale"], ksc),
+                "v_scale": put_scales(pool["v_scale"], vsc)}
+    return {"k": put_rows(pool["k"], ks), "v": put_rows(pool["v"], vs)}
+
+
+def decode_step_paged(params: dict, x_tok: Array, pos: Array, pool: dict,
+                      block_tables: Array, *, cfg, key_mask: Array,
+                      total_len: int, active: Array
+                      ) -> Tuple[Array, dict]:
+    """``decode_step`` against the paged pool: gather the dense view
+    through the block tables, run the one shared step math, scatter the
+    new row back into its page. ``active`` routes dead slots' writes to
+    the trash page (see ``_store_rows_paged``). Token-exact with the
+    dense step because the math between gather and scatter IS
+    ``_decode_step_math``."""
+    view = paged_view(pool, block_tables, total_len)
+    h_out, ks, vs = _decode_step_math(params, x_tok, pos, view, cfg=cfg,
+                                      key_mask=key_mask)
+    return h_out, _store_rows_paged(pool, ks, vs, pos, block_tables, active)
+
+
+def decode_loop_paged(params: dict, cur_tok: Array, pos: Array,
+                      active: Array, pool: dict, block_tables: Array, *,
+                      cfg, key_mask: Array, total_len: int, steps: int,
+                      embed_fn, sample_fn
+                      ) -> Tuple[Array, Array, Array, dict, Array]:
+    """``decode_loop`` over the paged pool: the same one-compile fused
+    K-step scan and emit-ring contract, with (cur_tok, pos, active, pool)
+    as the carry and the block tables a per-chunk constant (the host
+    grows them BEFORE dispatch — serve/engine.py maps every page the K
+    steps could write, so a mid-chunk page-boundary crossing finds its
+    page already mapped). Dead slots park at (tok 0, pos 0) writing the
+    trash page; emit semantics (-1 sentinel) are identical to the dense
+    loop."""
+
+    def one_step(carry, _):
+        cur_tok, pos, act, pool = carry
+        emit = jnp.where(act, cur_tok, -1)
+        x = embed_fn(cur_tok, pos)
+        h, pool = decode_step_paged(params, x, pos, pool, block_tables,
+                                    cfg=cfg, key_mask=key_mask,
+                                    total_len=total_len, active=act)
+        nxt = sample_fn(h, pos + 1)
+        pos = pos + 1
+        act = act & (pos < total_len)
+        cur_tok = jnp.where(act, nxt, 0)
+        pos = jnp.where(act, pos, 0)
+        return (cur_tok, pos, act, pool), emit
+
+    (cur_tok, pos, active, pool), emits = lax.scan(
+        one_step, (cur_tok, pos, active, pool), None, length=steps)
+    return cur_tok, pos, active, pool, jnp.moveaxis(emits, 0, 1)

@@ -65,3 +65,15 @@ def pcast_varying(x, axes):
     if hasattr(lax, "pcast"):
         return lax.pcast(x, tuple(axes), to="varying")
     return x
+
+
+def donate_if_accelerator(*argnums: int) -> tuple:
+    """``donate_argnums`` for jit, gated to real accelerators: ``()`` on
+    the CPU backend. CPU "donation" is a warning at best, and under the
+    persistent compilation cache it can MIS-ALIAS sharded buffers —
+    donated params came back as garbage in a resumed-run checkpoint
+    before every donation site adopted this gate. One definition keeps
+    the hazard and its fix in one place; the next donation site should
+    call this, not hand-roll the backend check."""
+    import jax
+    return tuple(argnums) if jax.default_backend() != "cpu" else ()

@@ -25,6 +25,8 @@ import jax
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dalle_pytorch_tpu.parallel import _compat
+
 
 
 def make_train_step(loss_fn: Callable, optimizer,
@@ -52,7 +54,10 @@ def make_train_step(loss_fn: Callable, optimizer,
     recompiles. Absent key = scale 1.
     """
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    # donation frees the old params/opt-state in place; CPU ignores it
+    donate = _compat.donate_if_accelerator(0, 1)
+
+    @functools.partial(jax.jit, donate_argnums=donate)
     def step(params, opt_state, batch, rng):
         batch = dict(batch)
         lr_scale = batch.pop("lr_scale", None)

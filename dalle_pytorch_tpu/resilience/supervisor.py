@@ -183,9 +183,14 @@ class TrainSupervisor:
         faults.maybe_signal(step)
         batch = faults.corrupt_batch(batch, step)
         if self.rewarm_steps > 0 and isinstance(batch, dict):
-            import jax.numpy as jnp
+            import jax
+            import numpy as np
             batch = dict(batch)
-            batch["lr_scale"] = jnp.float32(self.lr_scale(step))
+            # explicit transfer at the site: pre_step runs inside the
+            # --guard_transfers region (guards.no_transfers), where an
+            # implicit scalar upload would raise
+            batch["lr_scale"] = jax.device_put(
+                np.float32(self.lr_scale(step)))
         return batch
 
     def lr_scale(self, step: int) -> float:

@@ -5,6 +5,8 @@ Import surface kept lazy-friendly: ``scheduler`` pulls no jax, so queue
 types (Request/Result/QueueFull) are importable before a backend exists —
 the same discipline as ``resilience`` (utils/metrics.py note)."""
 
+from dalle_pytorch_tpu.serve.kv_pool import (  # noqa: F401
+    PageAllocator, PagePoolExhausted, pages_for)
 from dalle_pytorch_tpu.serve.scheduler import (  # noqa: F401
     CANCELLED, DEADLINE_EXCEEDED, ERROR, OK, REJECTED, InvalidRequest,
     QueueClosed, QueueFull, Request, RequestHandle, RequestQueue, Result,

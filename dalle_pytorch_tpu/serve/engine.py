@@ -49,6 +49,22 @@ the one-shot sampler's filters) with the same
 ``fold_in(request_rng, position)`` key discipline, and K only changes
 where the host reads the stream, never what the device computes.
 
+KV layouts (``kv=``): the default ``"dense"`` slot pool reserves
+``num_slots × seq_len`` KV rows up front; ``"paged"`` replaces it with a
+shared page pool + per-slot block tables (``serve/kv_pool.py``,
+``ops.decode.decode_loop_paged``) so HBM residency tracks where requests
+actually ARE in their sequences, not where they could end up — the same
+budget sustains strictly more concurrent requests (bench_serve asserts
+it). Pages are allocated at admission for the prompt span, grown ahead
+of each fused chunk as ``pos`` crosses page boundaries, and freed at
+completion/expiry/eviction; when the pool runs dry mid-decode the
+lowest-priority active request is EVICTED back to the queue (typed
+``PagePoolExhausted`` path — pages freed, request re-queued, its handle
+preserved; deterministic sampling replays its exact tokens on
+re-admission). The steady-state loop stays in the identical one-compile,
+transfer-clean, emit-ring regime: the only paged-specific host traffic
+is an explicit ``device_put`` of the tiny block table when it changes.
+
 Not supported per-request: classifier-free guidance (it doubles the
 stream per request; serve a guidance-dedicated engine instead) and padded
 prompt masks (requests carry unpadded codes, gen_dalle's default mode).
@@ -112,6 +128,9 @@ class Engine:
                  complete: Optional[Callable] = None,
                  metrics=None, log_every: int = 0,
                  quantize_cache: bool = False,
+                 kv: str = "dense",
+                 page_size: int = 0,
+                 num_pages: int = 0,
                  clock: Callable[[], float] = time.perf_counter):
         import jax
         import jax.numpy as jnp
@@ -130,6 +149,9 @@ class Engine:
         self.log_every = int(log_every)
         self.quantize_cache = bool(quantize_cache)
         self.clock = clock
+        self.kv = str(kv)
+        if self.kv not in ("dense", "paged"):
+            raise ValueError(f"kv must be 'dense' or 'paged', got {kv!r}")
 
         if prefill_buckets is None:
             buckets = S.prefill_buckets(cfg.text_seq_len)
@@ -153,10 +175,63 @@ class Engine:
         # flows into qkv, so the admission scatter matches what prefill
         # allocates (under bf16 params an f32 default would promote the
         # whole decode carry)
-        self.cache = decode_ops.init_cache(
-            cfg.transformer, S_, self.total_len,
-            dtype=params["text_emb"]["w"].dtype,
-            quantized=self.quantize_cache)
+        if self.kv == "paged":
+            from dalle_pytorch_tpu.serve import kv_pool as KV
+            self.page_size = int(page_size) or min(16, self.total_len)
+            if not 1 <= self.page_size <= self.total_len:
+                raise ValueError(
+                    f"page_size must be in [1, seq_len={self.total_len}], "
+                    f"got {self.page_size}")
+            # logical pages one full-length sequence spans = the block
+            # table width; also the floor on the pool (ONE request must
+            # always be able to run alone, or eviction could livelock)
+            self.slot_max_pages = KV.pages_for(self.total_len,
+                                               self.page_size)
+            full = S_ * self.slot_max_pages + 1   # + trash page
+            self.num_pages = int(num_pages) or full
+            if self.num_pages - 1 < self.slot_max_pages:
+                raise ValueError(
+                    f"num_pages={self.num_pages} cannot hold even one "
+                    f"full sequence ({self.slot_max_pages} pages of "
+                    f"{self.page_size} rows + the reserved trash page)")
+            self.cache = KV.init_page_pool(
+                cfg.transformer, self.num_pages, self.page_size,
+                dtype=params["text_emb"]["w"].dtype,
+                quantized=self.quantize_cache)
+            self.alloc = KV.PageAllocator(self.num_pages)
+            # the host owns the authoritative block tables (it owns the
+            # allocator); the device copy is pushed — one explicit
+            # device_put of a few KB — only when the mapping changes
+            self._bt_host = np.zeros((S_, self.slot_max_pages), np.int32)
+            self.block_tables = jax.device_put(self._bt_host)
+            self._bt_dirty = False
+            self._slot_pages: List[List[int]] = [[] for _ in range(S_)]
+            # safe host-side upper bound of each slot's device pos
+            # (t0 + K per dispatched chunk, capped): mapping ahead off
+            # this bound can over-allocate by at most one chunk, never
+            # lag the device
+            self._pos_est = [0] * S_
+            self._pages_samples: deque = deque(maxlen=10_000)
+            self.evicted = 0
+            self.deferred = 0            # DISTINCT page-deferred requests
+            self._deferred_ids: set = set()
+            # head-of-line page reservation: the oldest page-deferred
+            # request's id and need — while set, admission stops popping
+            # until that many pages are free, so completions' freed
+            # pages accumulate for it instead of being consumed by
+            # later, smaller requests (the no-starvation guarantee)
+            self._hol_rid: Optional[int] = None
+            self._hol_need = 0
+            # the smallest prompt span any admission could need: below
+            # this many free pages, popping the queue could only churn
+            # (pop -> defer -> requeue once per chunk)
+            self._min_admit_pages = KV.pages_for(min(self.buckets),
+                                                 self.page_size)
+        else:
+            self.cache = decode_ops.init_cache(
+                cfg.transformer, S_, self.total_len,
+                dtype=params["text_emb"]["w"].dtype,
+                quantized=self.quantize_cache)
         self.key_mask = jnp.ones((S_, self.total_len), bool)
         self.cur_tok = jnp.zeros((S_,), jnp.int32)
         self.pos = jnp.zeros((S_,), jnp.int32)
@@ -185,10 +260,12 @@ class Engine:
         self._last_log = 0
 
         # donating the cache lets XLA update the K/V buffers in place
-        # per chunk instead of copying them; CPU ignores donation with a
-        # warning, so only ask for it on a real accelerator
-        donate = (1,) if jax.default_backend() != "cpu" else ()
-        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=donate)
+        # per chunk instead of copying them
+        from dalle_pytorch_tpu.parallel._compat import donate_if_accelerator
+        donate = donate_if_accelerator(1)
+        impl = self._decode_impl_paged if self.kv == "paged" \
+            else self._decode_impl
+        self._decode_fn = jax.jit(impl, donate_argnums=donate)
         self._kill_fn = jax.jit(lambda active, keep: active & keep)
         self._prefill_fns: Dict = {}
         self._lock = threading.Lock()   # step_once is not reentrant
@@ -219,6 +296,32 @@ class Engine:
             cfg=self.cfg.transformer, key_mask=self.key_mask,
             steps=self.chunk_steps, embed_fn=embed_fn, sample_fn=sample_fn)
 
+    def _decode_impl_paged(self, params, cache, block_tables, cur_tok, pos,
+                           active, keys, temp, topk_k, top_p):
+        """The paged twin of ``_decode_impl``: identical fused K-step
+        emit-ring program, but K/V reads gather through the block tables
+        and writes scatter into the page pool
+        (``ops.decode.decode_loop_paged``). The block tables are a
+        per-chunk constant — the host maps every page the chunk could
+        write before dispatch — so this too traces exactly once."""
+        self.decode_traces += 1
+        from dalle_pytorch_tpu.models import dalle as D
+        from dalle_pytorch_tpu.ops import decode as decode_ops
+
+        def embed_fn(tok, p):
+            return D.decode_token_embed(params, self.cfg, tok, p)
+
+        def sample_fn(h, pred_pos):
+            logits = D.to_logits(params, h)
+            return D.sample_per_slot(logits, pred_pos, keys, temp,
+                                     topk_k, top_p, self.cfg)
+
+        return decode_ops.decode_loop_paged(
+            params["transformer"], cur_tok, pos, active, cache,
+            block_tables, cfg=self.cfg.transformer,
+            key_mask=self.key_mask, total_len=self.total_len,
+            steps=self.chunk_steps, embed_fn=embed_fn, sample_fn=sample_fn)
+
     def _prefill_fn(self, bucket: int):
         """Admission program for one prompt-length BUCKET: batched prefill
         of a full num_slots-row group (prompts padded to ``bucket``,
@@ -233,9 +336,13 @@ class Engine:
         import jax.numpy as jnp
         if bucket in self._prefill_fns:
             return self._prefill_fns[bucket]
+        paged = self.kv == "paged"
 
         def pre(params, cache, cur_tok, pos, active, rng, temp, topk_k,
-                top_p, text, lens, slots, n_seed, n_temp, n_topk, n_top_p):
+                top_p, text, lens, slots, n_seed, n_temp,
+                n_topk, n_top_p, page_rows=None):
+            # page_rows rides only the paged trace: dense admission
+            # omits it entirely (no dead argument, no wasted transfer)
             self.prefill_traces += 1
             self._prefill_trace_counts[bucket] = \
                 self._prefill_trace_counts.get(bucket, 0) + 1
@@ -252,8 +359,28 @@ class Engine:
                 params["transformer"], tokens, cfg=self.cfg.transformer,
                 total_len=self.total_len, prompt_mask=None,
                 quantize_cache=self.quantize_cache)
-            cache = {k: cache[k].at[:, slots].set(group[k], mode="drop")
-                     for k in cache}
+            if paged:
+                # scatter the group's [0, bucket) rows into their pages:
+                # row j of group-row g lands in physical page
+                # page_rows[g, j] (trash 0 for the unused dummy rows) at
+                # offset j % page_size. Advanced indices at dims 1 and 3
+                # are non-adjacent, so updates are (G, bucket, depth,
+                # heads[, dh])
+                off = (jnp.arange(bucket) % self.page_size)[None, :]
+                rows = {k: group[k][:, :, :, :bucket] for k in group}
+
+                def put(buf, val):
+                    if val.ndim == 5:
+                        return buf.at[:, page_rows, :, off, :].set(
+                            jnp.transpose(val, (1, 3, 0, 2, 4)))
+                    return buf.at[:, page_rows, :, off].set(
+                        jnp.transpose(val, (1, 3, 0, 2)))
+
+                cache = {k: put(cache[k], rows[k]) for k in cache}
+            else:
+                cache = {k: cache[k].at[:, slots].set(group[k],
+                                                      mode="drop")
+                         for k in cache}
             # logits at each row's TRUE last prompt position: rows are
             # padded to the bucket, but causality makes h[:, lens-1]
             # identical to the unpadded prefill's last row
@@ -324,6 +451,56 @@ class Engine:
                             f"(need 1..{self.cfg.text_seq_len})")
                 continue
             valid.append(h)
+        grants: dict = {}
+        if self.kv == "paged" and valid:
+            # admission is gated on FREE PAGES, not just free slots: the
+            # prompt span (rows [0, bucket), which prefill writes) must
+            # be mapped up front. The fit check runs in ARRIVAL order
+            # (pop_ready's priority+seq order, BEFORE bucket grouping)
+            # and stops at the first request that doesn't fit: it and
+            # everything behind it are re-queued — typed backpressure,
+            # not a drop. The blocked head's need is remembered
+            # (``_hol_need``) and step_once stops popping until that
+            # many pages are free; with requeue preserving arrival
+            # order, later/smaller requests can never consume the pages
+            # freed for it. A full sequence always fits the pool alone
+            # (constructor invariant), so the head always eventually
+            # fits and no request starves.
+            from dalle_pytorch_tpu.serve import kv_pool as KV
+            fits: List[S.RequestHandle] = []
+            for k, h in enumerate(valid):
+                rid = h.request.request_id
+                need = KV.pages_for(S.bucket_for(len(h.request.codes),
+                                                 self.buckets),
+                                    self.page_size)
+                if self.alloc.free < need:
+                    # head-of-line block: requeue this and every later
+                    # pop (arrival order preserved by queue_seq)
+                    for hh in valid[k:]:
+                        self.queue.requeue(hh)
+                    self._hol_rid = rid
+                    self._hol_need = need
+                    # a waiting request is re-popped once it could fit;
+                    # count it (and log it) only on the transition INTO
+                    # the deferred state, so stats()["deferred"] means
+                    # distinct requests, not churn
+                    if rid not in self._deferred_ids:
+                        self._deferred_ids.add(rid)
+                        self.deferred += 1
+                        if self.metrics is not None:
+                            self.metrics.event(**S.structured_event(
+                                "serve_page_defer",
+                                request_id=rid,
+                                pages_needed=need,
+                                pages_free=self.alloc.free))
+                    break
+                fits.append(h)
+                self._deferred_ids.discard(rid)
+                if rid == self._hol_rid:
+                    self._hol_rid = None
+                    self._hol_need = 0
+                grants[rid] = self.alloc.alloc(need)
+            valid = fits
         for bucket, group in S.group_by_bucket(valid, self.buckets).items():
             idx = free[:len(group)]
             free = free[len(group):]
@@ -334,6 +511,10 @@ class Engine:
             text = np.zeros((G, bucket), np.int32)
             lens = np.ones((G,), np.int32)
             slots = np.full((G,), self.num_slots, np.int32)
+            # paged only — unused rows' prompt rows scatter into the
+            # trash page 0; dense prefill takes no page_rows at all
+            page_rows = np.zeros((G, bucket), np.int32) \
+                if self.kv == "paged" else None
             n_seed = np.zeros((G,), np.int32)
             n_temp = np.ones((G,), np.float32)
             n_topk = np.ones((G,), np.int32)
@@ -344,6 +525,12 @@ class Engine:
                 text[j, :len(req.codes)] = req.codes
                 lens[j] = len(req.codes)
                 slots[j] = idx[j]
+                if self.kv == "paged":
+                    pages = grants[req.request_id]
+                    self._bt_host[idx[j], :] = 0
+                    self._bt_host[idx[j], :len(pages)] = pages
+                    page_rows[j] = self._bt_host[
+                        idx[j], np.arange(bucket) // self.page_size]
                 # two's-complement truncation to int32: PRNGKey keeps
                 # only the low 32 bits under the default x64-off mode,
                 # so this is value-identical to PRNGKey(seed) eager
@@ -363,12 +550,20 @@ class Engine:
                     self.top_p, jax.device_put(text),
                     jax.device_put(lens), jax.device_put(slots),
                     jax.device_put(n_seed), jax.device_put(n_temp),
-                    jax.device_put(n_topk), jax.device_put(n_top_p))
+                    jax.device_put(n_topk), jax.device_put(n_top_p),
+                    **({"page_rows": jax.device_put(page_rows)}
+                       if self.kv == "paged" else {}))
             except Exception as e:  # noqa: BLE001 — no-hangs contract
                 # the group's slots were never assigned (still None) and
                 # the device state is rebound only on success below, so
                 # the pool stays consistent; the group's callers get a
                 # typed error instead of hanging on a dead loop
+                if self.kv == "paged":
+                    for j, h in enumerate(group):
+                        self.alloc.release(
+                            grants.pop(h.request.request_id))
+                        self._bt_host[idx[j], :] = 0
+                    self._bt_dirty = True
                 for h in group:
                     self._error(h, now, f"prefill failed: {e!r}")
                 continue
@@ -376,20 +571,140 @@ class Engine:
              self.temp, self.topk_k, self.top_p) = outs
             for i, h in zip(idx, group):
                 self.slots[i] = _Slot(h, len(h.request.codes), now)
+                if self.kv == "paged":
+                    self._slot_pages[i] = grants.pop(h.request.request_id)
+                    self._pos_est[i] = len(h.request.codes)
+                    self._bt_dirty = True
+
+    # -- page-pool lifecycle (kv='paged') -----------------------------------
+
+    def _release_slot_pages(self, i: int) -> None:
+        """Free slot i's pages back to the pool and zero its block-table
+        row (completion/expiry/eviction/terminate). The stale device-side
+        row needs no urgent push: the dead slot's writes are redirected
+        to the trash page inside the program (active=False), and reads of
+        re-assigned pages are causally masked."""
+        if self._slot_pages[i]:
+            self.alloc.release(self._slot_pages[i])
+            self._slot_pages[i] = []
+        self._bt_host[i, :] = 0
+        self._pos_est[i] = 0
+        self._bt_dirty = True
+
+    def _free_slot(self, i: int) -> None:
+        """The one slot-teardown path (completion/expiry/eviction/
+        terminate): vacate the slot and, in paged mode, return its pages
+        to the pool — forgetting the paged branch would leak pages until
+        the pool wedged, so no call site spells it out by hand."""
+        self.slots[i] = None
+        if self.kv == "paged":
+            self._release_slot_pages(i)
+
+    def _evict_lowest_priority(self, now: float) -> bool:
+        """The PagePoolExhausted backpressure path: evict the LOWEST-
+        priority active request (highest priority value; ties broken by
+        latest admission) back to the queue. Its pages are freed, its
+        device slot killed, and its handle re-queued intact — on
+        re-admission, deterministic sampling (same seed, same fold_in
+        positions) replays its exact token stream, so eviction costs
+        latency, never correctness. Returns False when no slot is
+        active."""
+        import jax
+        cand = [(s.handle.request.priority, s.t_admit, i)
+                for i, s in enumerate(self.slots) if s is not None]
+        if not cand:
+            return False
+        _, _, i = max(cand)
+        slot = self.slots[i]
+        freed = len(self._slot_pages[i])
+        self._free_slot(i)
+        keep = np.ones((self.num_slots,), bool)
+        keep[i] = False
+        self.active = self._kill_fn(self.active, jax.device_put(keep))
+        self.evicted += 1
+        # un-credit the victim's harvested tokens: re-admission replays
+        # them all, so leaving the prefix counted would inflate
+        # tokens_decoded/mean_occupancy by one prefix per eviction (the
+        # same double-count _harvest_chunk avoids by dropping the
+        # orphaned mid-flight ring row)
+        self.tokens_decoded -= len(slot.emitted)
+        self.occupancy_sum -= len(slot.emitted)
+        self.queue.requeue(slot.handle)
+        if self.metrics is not None:
+            self.metrics.event(**S.structured_event(
+                "serve_evict", request_id=slot.handle.request.request_id,
+                priority=slot.handle.request.priority, pages_freed=freed,
+                pages_free=self.alloc.free,
+                waited_s=round(now - slot.handle.request.submit_t, 4)))
+        return True
+
+    def _map_ahead(self, now: float) -> None:
+        """Grow-by-one-page, BEFORE every chunk dispatch: each active
+        slot's block table must map every row the K fused steps could
+        write ([pos, pos+K)), so a page-boundary crossing inside the
+        chunk never needs a host sync. Growth works off the host's safe
+        pos upper bound (``_pos_est``); when the free list runs dry the
+        typed ``PagePoolExhausted`` is converted into evictions of the
+        lowest-priority active request until the remainder fits (a full
+        sequence always fits the pool alone, so this terminates — in the
+        limit the growing slot evicts itself and re-queues)."""
+        from dalle_pytorch_tpu.serve import kv_pool as KV
+        for i in range(self.num_slots):
+            while self.slots[i] is not None:
+                target = min(self._pos_est[i] + self.chunk_steps,
+                             self.total_len)
+                short = KV.pages_for(target, self.page_size) \
+                    - len(self._slot_pages[i])
+                if short <= 0:
+                    break
+                if self.alloc.free >= short:
+                    for p in self.alloc.alloc(short):
+                        self._bt_host[i, len(self._slot_pages[i])] = p
+                        self._slot_pages[i].append(p)
+                    self._bt_dirty = True
+                    break
+                # pool exhausted mid-decode: typed backpressure — the
+                # victim may be slot i itself, which ends its while loop
+                if not self._evict_lowest_priority(now):
+                    # unreachable while slot i is active (it is its own
+                    # candidate); defensive: never spin on a dry pool
+                    break
+
+    def _sync_block_tables(self) -> None:
+        """Push the host's authoritative block tables to the device when
+        the mapping changed — ONE explicit device_put of a few KB, the
+        only paged-specific host->device traffic in steady state."""
+        import jax
+        if self._bt_dirty:
+            self.block_tables = jax.device_put(self._bt_host)
+            self._bt_dirty = False
 
     # -- the fused-chunk pipeline -------------------------------------------
 
-    def _dispatch_chunk(self) -> None:
+    def _dispatch_chunk(self, now: float) -> None:
         """Launch one K-step fused program from the current device state
         and queue its emit ring for a later harvest. No host sync here:
         the outputs are futures, and the device starts computing while
         the host goes on to admit/harvest."""
-        outs = self._decode_fn(self.params, self.cache, self.cur_tok,
-                               self.pos, self.active, self.rng, self.temp,
-                               self.topk_k, self.top_p)
+        if self.kv == "paged":
+            self._map_ahead(now)
+            self._sync_block_tables()
+            self._pages_samples.append(self.alloc.in_use)
+            outs = self._decode_fn(self.params, self.cache,
+                                   self.block_tables, self.cur_tok,
+                                   self.pos, self.active, self.rng,
+                                   self.temp, self.topk_k, self.top_p)
+        else:
+            outs = self._decode_fn(self.params, self.cache, self.cur_tok,
+                                   self.pos, self.active, self.rng,
+                                   self.temp, self.topk_k, self.top_p)
         self.cur_tok, self.pos, self.active, self.cache, ring = outs
         owners = [(i, s) for i, s in enumerate(self.slots)
                   if s is not None]
+        if self.kv == "paged":
+            for i, _ in owners:
+                self._pos_est[i] = min(self._pos_est[i] + self.chunk_steps,
+                                       self.total_len)
         self._pending.append(_Chunk(ring, self.active, owners))
         self.decode_steps += self.chunk_steps
 
@@ -408,16 +723,20 @@ class Engine:
         now = self.clock()
         emitted = 0
         for i, slot in rec.owners:
-            if slot.handle.done():
-                # expired/killed/errored since dispatch — its ring row
-                # is dead, and slot i may already belong to a newer
-                # request whose tokens start in a later chunk
+            if slot.handle.done() or self.slots[i] is not slot:
+                # expired/killed/errored/EVICTED since dispatch — its
+                # ring row is dead (an evicted request replays every
+                # token on re-admission, so crediting these to the
+                # orphaned slot would double-count them in
+                # tokens_decoded/occupancy), and slot i may already
+                # belong to a newer request whose tokens start in a
+                # later chunk
                 continue
             row = ring[i]
             toks = row[row >= 0]
             slot.emitted.extend(int(t) for t in toks)
             emitted += len(toks)
-            if self.slots[i] is slot and not bool(active_after[i]):
+            if not bool(active_after[i]):
                 self._complete(i, slot, now)
         self.tokens_decoded += emitted
         self.occupancy_sum += emitted
@@ -439,7 +758,7 @@ class Engine:
             queued_s=round(slot.t_admit - req.submit_t, 6),
             decode_s=round(now - slot.t_admit, 6),
             total_s=round(now - req.submit_t, 6)))
-        self.slots[i] = None
+        self._free_slot(i)
 
     # -- the loop -----------------------------------------------------------
 
@@ -477,7 +796,7 @@ class Engine:
                 dt = slot.handle.request.deadline_t
                 if dt is not None and now > dt:
                     self._expire(slot.handle, now, where="decoding")
-                    self.slots[i] = None
+                    self._free_slot(i)
                     kill.append(i)
             if kill:
                 keep = np.ones((self.num_slots,), bool)
@@ -487,16 +806,31 @@ class Engine:
                 did = True
 
             free = self.num_slots - self.active_slots()
+            if self.kv == "paged":
+                # don't pop just to defer/requeue every chunk (n=0 still
+                # reaps queued deadline expiries): with a head-of-line
+                # request waiting, hold admission until ITS need is
+                # free — freed pages accumulate for it; otherwise the
+                # floor is the smallest bucket's prompt span
+                floor = self._hol_need if self._hol_rid is not None \
+                    else self._min_admit_pages
+                if self.alloc.free < floor:
+                    free = 0
             ready, expired = self.queue.pop_ready(free, now)
             for h in expired:
                 self._expire(h, now, where="queued")
+                if self.kv == "paged":
+                    self._deferred_ids.discard(h.request.request_id)
+                    if h.request.request_id == self._hol_rid:
+                        self._hol_rid = None
+                        self._hol_need = 0
             if ready:
                 self._admit(ready, now)
             did = did or bool(ready or expired)
 
             dispatched = False
             if self.active_slots() > 0:
-                self._dispatch_chunk()
+                self._dispatch_chunk(now)
                 dispatched = did = True
 
             # double buffer: while dispatching, keep exactly one chunk
@@ -515,14 +849,21 @@ class Engine:
                 self.metrics.event(event="serve", **self.stats())
             return did
 
+    def idle(self) -> bool:
+        """True when there is nothing left to do: queue empty, every slot
+        free, every in-flight chunk harvested. The termination predicate
+        for any caller driving ``step_once`` by hand (``run_until_idle``,
+        bench_serve's budget-compare loop)."""
+        return self.queue.depth() == 0 and self.active_slots() == 0 \
+            and not self._pending
+
     def run_until_idle(self, max_steps: int = 1_000_000) -> None:
         """Drive until the queue is empty, every slot is free, and every
         in-flight chunk is harvested (tests, bench). ``max_steps`` is a
         runaway guard, not a budget."""
         for _ in range(max_steps):
             busy = self.step_once()
-            if not busy and self.queue.depth() == 0 \
-                    and self.active_slots() == 0 and not self._pending:
+            if not busy and self.idle():
                 return
         raise RuntimeError(f"engine did not go idle in {max_steps} steps")
 
@@ -551,8 +892,7 @@ class Engine:
                         pass
                 stop.wait(idle_sleep_s)     # never hot-spin on a
                 continue                    # persistent fault
-            if not busy and self.queue.depth() == 0 \
-                    and self.active_slots() == 0 and not self._pending:
+            if not busy and self.idle():
                 stop.wait(idle_sleep_s)
 
     def _terminate_active(self, status: str, reason: str) -> int:
@@ -574,12 +914,14 @@ class Engine:
                     reason=reason,
                     queued_s=round(slot.t_admit - req.submit_t, 6),
                     total_s=round(now - req.submit_t, 6)))
-                self.slots[i] = None
+                self._free_slot(i)
                 n += 1
             self._pending.clear()
             self.cur_tok = jnp.zeros((self.num_slots,), jnp.int32)
             self.pos = jnp.zeros((self.num_slots,), jnp.int32)
             self.active = jnp.zeros((self.num_slots,), bool)
+            if self.kv == "paged":
+                self._sync_block_tables()
         return n
 
     def fail_active(self, reason: str) -> int:
@@ -600,10 +942,41 @@ class Engine:
         engine's life; the guards.compile_count counter in tests)."""
         return self._prefill_trace_counts.get(bucket, 0)
 
+    def kv_hbm_bytes(self) -> int:
+        """Resident HBM bytes of the KV store — the page pool under
+        ``kv='paged'``, the full slot cache under ``kv='dense'`` (what
+        bench_serve's budget comparison reads)."""
+        from dalle_pytorch_tpu.serve import kv_pool as KV
+        return KV.pool_bytes(self.cache)
+
+    def pages_in_use_p95(self) -> int:
+        """Nearest-rank p95 of pages in use, sampled at every chunk
+        dispatch (paged mode only; 0 before any dispatch)."""
+        if self.kv != "paged" or not self._pages_samples:
+            return 0
+        s = sorted(self._pages_samples)
+        return s[min(int(0.95 * len(s)), len(s) - 1)]
+
     def stats(self) -> dict:
         elapsed = None if self._t_start is None \
             else max(self.clock() - self._t_start, 1e-9)
+        paged = {}
+        if self.kv == "paged":
+            paged = {
+                "page_size": self.page_size,
+                "num_pages": self.num_pages,
+                "pages_in_use": self.alloc.in_use,
+                "pages_free": self.alloc.free,
+                "pages_peak": self.alloc.peak_in_use,
+                "pages_in_use_p95": self.pages_in_use_p95(),
+                "evicted": self.evicted,
+                "deferred": self.deferred,
+                "requeued": self.queue.requeued,
+            }
         return {
+            "kv": self.kv,
+            "kv_hbm_bytes": self.kv_hbm_bytes(),
+            **paged,
             "queue_depth": self.queue.depth(),
             "active_slots": self.active_slots(),
             "num_slots": self.num_slots,

@@ -1,0 +1,156 @@
+"""Paged KV-cache subsystem: the block-pool memory manager.
+
+The dense slot pool (serve/engine.py, ``kv="dense"``) reserves
+``num_slots × seq_len`` KV rows of HBM per layer up front, so HBM — not
+compute — caps serving concurrency: a slot 10 tokens into a 1280-token
+sequence holds 1280 rows of memory. Paged KV (PAPERS.md "Ragged Paged
+Attention"; the Gemma-on-TPU serving study credits this exact mechanism
+for most of its throughput headroom) breaks the cache into fixed-size
+PAGES shared by every slot:
+
+  * the device side is a page pool ``(depth, num_pages, heads,
+    page_size, dim_head)`` per K and V (``init_page_pool``; int8 variant
+    carries per-row scale pages) plus per-slot block tables
+    ``(num_slots, max_pages)`` int32 mapping logical page j → physical
+    page id — ``ops.decode.paged_view`` / ``_store_rows_paged`` are the
+    gather/scatter through them;
+  * the host side is THIS module's ``PageAllocator``: a free-list over
+    physical pages. Physical page 0 is reserved as the TRASH page —
+    dead slots park their writes there (see ops/decode.py), so it is
+    never handed out;
+  * the lifecycle is allocate-on-admission for the prompt span, grow by
+    one page as ``pos`` crosses a page boundary (the engine maps ahead
+    of every fused K-step chunk, so growth never needs a mid-chunk
+    host sync), and free-on-completion/expiry/eviction.
+
+Overcommit is the point: the engine may run more slots than
+``num_pages`` could hold at full length, because concurrent requests sit
+at ragged positions. When the pool genuinely runs out mid-decode, the
+typed ``PagePoolExhausted`` backpressure path EVICTS the lowest-priority
+active request back to the queue — pages freed, request re-queued with
+its original handle, never dropped — and deterministic sampling replays
+its exact tokens on re-admission (docs/SERVING.md "Paged KV").
+
+Module-level imports stay jax-free (the ``serve`` package's lazy-import
+discipline): queue-side callers can type-check against
+``PagePoolExhausted`` before a backend exists.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from dalle_pytorch_tpu.utils.metrics import structured_event
+
+# physical page 0 is reserved: dead slots' parked writes land here, and
+# unmapped block-table entries point here (reads of it are never attended)
+TRASH_PAGE = 0
+
+
+class PagePoolExhausted(RuntimeError):
+    """Typed page backpressure: an allocation the free-list cannot serve.
+    ``record`` is the structured event (kind ``serve_page_exhausted``)
+    carrying the shortfall — the engine's eviction path catches this and
+    converts it into a requeue, never a dropped request or a wedged
+    loop."""
+
+    def __init__(self, record: dict):
+        super().__init__(
+            f"page pool exhausted: need {record.get('pages_needed')}, "
+            f"free {record.get('pages_free')} of "
+            f"{record.get('pages_capacity')}")
+        self.record = record
+
+
+def pages_for(rows: int, page_size: int) -> int:
+    """Pages needed to hold ``rows`` KV rows (ceil division)."""
+    return -(-rows // page_size)
+
+
+def init_page_pool(cfg, num_pages: int, page_size: int, dtype=None,
+                   quantized: bool = False) -> dict:
+    """Device-resident page pool: ``(depth, num_pages, heads, page_size,
+    dim_head)`` K/V buffers (int8 + per-row f32 scale pages when
+    ``quantized`` — the same layout/accuracy contract as
+    ``ops.decode.init_cache``, so int8-KV composes with paging
+    unchanged)."""
+    import jax.numpy as jnp
+    if dtype is None:
+        dtype = jnp.float32
+    shape = (cfg.depth, num_pages, cfg.heads, page_size, cfg.dim_head)
+    if quantized:
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+                "v_scale": jnp.zeros(shape[:-1], jnp.float32)}
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def pool_bytes(pool: dict) -> int:
+    """Resident HBM bytes of a pool (or of a dense cache dict) — the
+    number ``bench_serve --serve_kv`` compares across layouts."""
+    return int(sum(x.nbytes for x in pool.values()))
+
+
+class PageAllocator:
+    """Host-side free-list over physical pages ``[1, num_pages)`` (page 0
+    is the reserved trash page). Single-threaded by design — the engine
+    owns it under its step lock, like every other piece of slot
+    bookkeeping."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (one trash page + at least one "
+                f"allocatable), got {num_pages}")
+        self.num_pages = int(num_pages)
+        # pop() hands out the lowest free id first — deterministic page
+        # placement makes failures reproducible
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._free_set = set(self._free)   # O(1) double-release check
+        self.peak_in_use = 0
+        self.allocs = 0
+
+    @property
+    def capacity(self) -> int:
+        return self.num_pages - 1          # trash page excluded
+
+    @property
+    def free(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.capacity - self.free
+
+    def alloc(self, n: int) -> List[int]:
+        """Hand out ``n`` physical page ids, or raise the typed
+        ``PagePoolExhausted`` (the caller decides between deferring the
+        request and evicting a victim)."""
+        if n > self.free:
+            raise PagePoolExhausted(structured_event(
+                "serve_page_exhausted", pages_needed=int(n),
+                pages_free=self.free, pages_in_use=self.in_use,
+                pages_capacity=self.capacity))
+        out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
+        self.allocs += n
+        self.peak_in_use = max(self.peak_in_use, self.in_use)
+        return out
+
+    def release(self, pages: List[int]) -> None:
+        """Return pages to the free list (completion/expiry/eviction).
+        A double release is a hard error, not a warning: a page freed
+        twice would sit in the free list twice and eventually be handed
+        to TWO live slots, whose decode writes would silently interleave
+        in the shared page — wrong tokens with no signal. Fail at the
+        bug's site instead."""
+        for p in pages:
+            if not 1 <= p < self.num_pages:
+                raise ValueError(f"page id {p} was never allocatable")
+            if p in self._free_set:
+                raise ValueError(
+                    f"double release of page {p}: it is already free — "
+                    f"two slots would end up sharing it")
+            self._free.append(p)
+            self._free_set.add(p)

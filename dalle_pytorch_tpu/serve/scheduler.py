@@ -174,6 +174,12 @@ class RequestHandle:
         self.request = request
         self._done = threading.Event()
         self._result: Optional[Result] = None
+        # arrival order within the priority class, assigned at submit;
+        # requeue (eviction/page-defer) re-inserts with the SAME seq so
+        # a request never loses its place in line — without this, a
+        # large-prompt request deferred on pages would re-enter behind a
+        # steady stream of small requests and could starve forever
+        self.queue_seq: int = -1
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -214,8 +220,10 @@ class RequestQueue:
         self._seq = itertools.count()
         self._lock = threading.Lock()
         self._closed = False
+        self._drained = False
         self.submitted = 0
         self.rejected = 0
+        self.requeued = 0
 
     def depth(self) -> int:
         with self._lock:
@@ -260,9 +268,38 @@ class RequestQueue:
             request = dataclasses.replace(request, request_id=rid,
                                           submit_t=now)
             handle = RequestHandle(request)
+            handle.queue_seq = next(self._seq)
             heapq.heappush(self._heap,
-                           (request.priority, next(self._seq), handle))
+                           (request.priority, handle.queue_seq, handle))
             return handle
+
+    def requeue(self, handle: RequestHandle) -> None:
+        """Push an already-admitted request BACK into the queue — the
+        paged engine's eviction/page-backpressure path (a victim's pages
+        are freed and the request re-enters the line, never dropped).
+        The handle and its original ``submit_t`` are preserved, so the
+        caller's future stays live and latency accounting covers both
+        attempts. Deliberately not subject to ``max_depth`` (the request
+        already passed admission once; shedding it here would turn
+        backpressure into a silent drop) nor to ``close()`` gating. It
+        re-enters at its ORIGINAL arrival position (``queue_seq``), not
+        the back of its priority class: together with the engine's
+        head-of-line page reservation this is what makes 'no request
+        starves forever' true — later-arriving requests can never leap-
+        frog a page-deferred one indefinitely. A requeue landing AFTER
+        the shutdown drain fulfils the handle as ``cancelled`` on the
+        spot: the heap is dead by then, nobody would ever pop it, and
+        leaving it there would strand the caller in ``result()``."""
+        with self._lock:
+            if self._drained:
+                handle.fulfill(Result(
+                    status=CANCELLED,
+                    request_id=handle.request.request_id,
+                    reason="server shutdown"))
+                return
+            self.requeued += 1
+            heapq.heappush(self._heap, (handle.request.priority,
+                                        handle.queue_seq, handle))
 
     def pop_ready(self, n: int,
                   now: Optional[float] = None
@@ -291,8 +328,12 @@ class RequestQueue:
 
     def drain(self) -> List[RequestHandle]:
         """Remove and return everything still queued (shutdown path — the
-        server fulfils them as ``cancelled``)."""
+        server fulfils them as ``cancelled``). After the drain the heap
+        is dead: a late ``requeue`` (e.g. an engine thread that outlived
+        ``close()``'s join timeout evicting a victim) is fulfilled as
+        ``cancelled`` instead of being stranded."""
         with self._lock:
+            self._drained = True
             out = [h for _, _, h in self._heap]
             self._heap.clear()
         return out

@@ -48,6 +48,9 @@ class InferenceServer:
                  chunk_steps: int = 8,
                  prefill_buckets=None,
                  quantize_cache: bool = False,
+                 kv: str = "dense",
+                 page_size: int = 0,
+                 num_pages: int = 0,
                  clip_params: Optional[dict] = None, clip_cfg=None,
                  decode_images: bool = True,
                  metrics=None, log_every: int = 50,
@@ -76,7 +79,8 @@ class InferenceServer:
             params, cfg, self.queue, num_slots=num_slots,
             chunk_steps=chunk_steps, prefill_buckets=prefill_buckets,
             complete=self._on_decoded, metrics=metrics,
-            log_every=log_every, quantize_cache=quantize_cache)
+            log_every=log_every, quantize_cache=quantize_cache,
+            kv=kv, page_size=page_size, num_pages=num_pages)
 
         # bounded window: p50/p95 over the last 10k completions — an
         # unbounded list would grow (and re-sort under the lock) forever

@@ -17,7 +17,25 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+# Share XLA executables across the run via the persistent compilation
+# cache (fresh per-run dir — nothing leaks between runs). Many tests
+# build identical programs from DISTINCT jit objects (every serve test
+# constructs its own Engine, whose fused decode program re-traces but
+# compiles to the same HLO), and on the CPU backend XLA compilation
+# dominates tier-1 wall time. Trace-count contracts are unaffected:
+# guards.compile_count and Engine.decode_traces count TRACES, which
+# still happen once per jit object.
+_cache_dir = tempfile.mkdtemp(prefix="jaxcache-")
+atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -1,0 +1,58 @@
+"""JL009 corpus: eager lax control flow on device-derived operands.
+
+True positives carry the expect-marker comment; everything else is the
+neighbouring LEGAL idiom (control flow inside jit, python branches on
+host data, operands rebound to host values) and must NOT be flagged.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+step = jax.jit(lambda x: x + 1)
+
+
+def eager_cond_on_jit_output(x):
+    y = step(x)
+    return lax.cond(y[0] > 0, lambda: 1.0, lambda: 2.0)  # expect: JL009
+
+
+def eager_while_on_jit_carry(x):
+    y = step(x)
+    return lax.while_loop(lambda c: c[0] < 3, lambda c: c + 1, y)  # expect: JL009
+
+
+def eager_switch_on_jit_index(x):
+    idx = step(x)
+    return lax.switch(idx, [lambda: 0, lambda: 1])  # expect: JL009
+
+
+def eager_cond_on_direct_jit_call(x):
+    return lax.cond(step(x)[0] > 0, lambda: 1.0, lambda: 2.0)  # expect: JL009
+
+
+@jax.jit
+def legal_cond_inside_jit(x):
+    # traced region: the conditional compiles into the program, no sync
+    return lax.cond(x[0] > 0, lambda: x, lambda: -x)
+
+
+def legal_scan_body_while(x):
+    # referenced by jax.jit below -> trace root, not eager dispatch
+    return lax.while_loop(lambda c: c[0] < 3, lambda c: c + 1, step(x))
+
+
+_jitted_wrapper = jax.jit(legal_scan_body_while)
+
+
+def legal_python_branch_on_host_flag(flag, x):
+    # the predicate is a plain python value, not device data
+    if flag:
+        return x
+    return lax.cond(flag, lambda: 1.0, lambda: 2.0)
+
+
+def legal_rebound_to_host_value(x):
+    y = step(x)
+    y = 3  # rebound to host data before the control op
+    return lax.cond(y > 0, lambda: 1.0, lambda: 2.0)

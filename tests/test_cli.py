@@ -58,7 +58,12 @@ def vae_args(root, extra=()):
 class TestTrainVAE:
     def test_two_epochs_decreasing_loss_and_artifacts(self, workdir):
         from dalle_pytorch_tpu.cli.train_vae import main
-        main(vae_args(workdir, ["--n_epochs", "2", "--tempsched"]))
+        # --guard_transfers: the CI train smoke runs the real step body
+        # under analysis.guards.no_transfers — an implicit host<->device
+        # transfer creeping into the hot path fails the test, naming the
+        # offending call (ROADMAP's no_transfers-around-train-step item)
+        main(vae_args(workdir, ["--n_epochs", "2", "--tempsched",
+                                "--guard_transfers"]))
 
         # loss decreased epoch 0 -> 1
         losses = {}
@@ -122,6 +127,7 @@ class TestTrainDALLE:
             "--models_dir", str(workdir / "models"),
             "--results_dir", str(workdir / "results"),
             "--log_interval", "1", "--dp", "1", "--sample_every", "1",
+            "--guard_transfers",
         ])
         # checkpoint + vocab + sample grid exist
         path, epoch = ckpt.latest(str(workdir / "models"), "toy_dalle")
@@ -707,7 +713,7 @@ class TestTrainCLIP:
             "--visual_patch_size", "8", "--dense", "--lr", "1e-3",
             "--models_dir", str(workdir / "models"),
             "--results_dir", str(workdir / "results"),
-            "--log_interval", "1", "--dp", "1",
+            "--log_interval", "1", "--dp", "1", "--guard_transfers",
         ])
         path, epoch = ckpt.latest(str(workdir / "models"), "clipcli")
         assert epoch == 0
