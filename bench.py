@@ -1368,6 +1368,129 @@ def _serve_kv_budget_compare(params, cfg, *, num_slots, page_size,
     return out
 
 
+def _serve_replica_compare(params, cfg, *, replicas, num_slots, n_req,
+                           kv, page_size, chunk_steps=8):
+    """The replica-set headline: N supervised engines behind one queue
+    must beat one engine at the SAME offered load (more slots in flight;
+    with one jax device per replica the fused chunks genuinely overlap),
+    with the steady state still transfer-clean and the decode program
+    compiled exactly once PER REPLICA — and a replica killed mid-sweep
+    by the deterministic serve fault must cost zero requests (failover
+    reclaims its in-flight work and replays it on the survivors;
+    deterministic sampling makes the replay token-exact, which
+    tests/test_replica.py pins byte-for-byte). Both halves are ASSERTED,
+    not just measured, so CI's serve-faults smoke greps one 'error'
+    field."""
+    from dalle_pytorch_tpu.analysis import guards
+    from dalle_pytorch_tpu.resilience import faults
+    from dalle_pytorch_tpu.serve import Request, RequestQueue, \
+        SamplingParams
+    from dalle_pytorch_tpu.serve.replica import ReplicaSet
+
+    prompt_len = min(4, cfg.text_seq_len)
+    # enough offered work to keep every leg queue-bound for several
+    # waves (the comparison needs slots, not arrivals, binding)
+    n_load = max(n_req, 4 * replicas * num_slots)
+    out = {"replicas": replicas, "requests": n_load}
+
+    def build(R, warm=True):
+        queue = RequestQueue(max_depth=max(4 * n_load, 16))
+        rs = ReplicaSet(params, cfg, queue, replicas=R,
+                        num_slots=num_slots, chunk_steps=chunk_steps,
+                        kv=kv,
+                        page_size=page_size if kv == "paged" else 0)
+        if warm:
+            # warm every replica's prefill bucket + fused decode
+            # program outside the timed/guarded regions (time_steps'
+            # warmup discipline)
+            handles = [queue.submit(Request(
+                codes=(1,) * prompt_len, seed=i,
+                sampling=SamplingParams()))
+                for i in range(R * num_slots)]
+            rs.run_until_idle()
+            for h in handles:
+                h.result(timeout=120)
+        return rs, queue
+
+    def submit_burst(queue):
+        return [queue.submit(Request(
+            codes=(1 + i % 7,) * prompt_len, seed=i,
+            sampling=SamplingParams())) for i in range(n_load)]
+
+    # throughput legs run THREADED (thread per replica + supervisor —
+    # the serve_dalle --replicas deployment mode): one replica's host
+    # bookkeeping overlaps the others' chunk compute, and with one jax
+    # device per replica the chunks themselves overlap. Best-of-2 to
+    # shave scheduler noise off a short measurement.
+    for R in (1, replicas):
+        rs, queue = build(R)
+        rs.start()
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            handles = submit_burst(queue)
+            ok = sum(h.result(timeout=120).status == "ok"
+                     for h in handles)
+            wall = time.perf_counter() - t0
+            if ok != n_load:
+                raise AssertionError(
+                    f"replicas={R}: only {ok}/{n_load} completed")
+            best = wall if best is None else min(best, wall)
+        rs.close()
+        compiles = rs.decode_compiles_per_replica()
+        out[f"r{R}"] = {
+            "wall_s": round(best, 4),
+            "throughput_imgs_per_s": round(n_load / best, 3),
+            "decode_compiles_per_replica": compiles,
+        }
+        if any(c != 1 for c in compiles):
+            raise AssertionError(
+                f"replicas={R}: decode compiled {compiles} times across "
+                f"replicas — the one-compile-per-replica contract broke")
+    if out[f"r{replicas}"]["throughput_imgs_per_s"] \
+            <= out["r1"]["throughput_imgs_per_s"]:
+        raise AssertionError(
+            f"{replicas} replicas did not beat 1 at the same offered "
+            f"load: {out[f'r{replicas}']['throughput_imgs_per_s']} vs "
+            f"{out['r1']['throughput_imgs_per_s']} imgs/s")
+
+    # contract leg, single-threaded drive: the replicated steady state
+    # is still TRANSFER-CLEAN (the same guards.no_transfers the K-sweep
+    # runs under; routing hand-offs are host-side, harvests stay one
+    # explicit device_get per chunk per replica)
+    rs, queue = build(replicas)
+    with guards.no_transfers():
+        point = _serve_load_point(rs, queue, 1000.0,
+                                  min(n_req, n_load), prompt_len)
+    if point["completed"] != min(n_req, n_load):
+        raise AssertionError(
+            f"transfer-clean leg: only {point['completed']} completed")
+    out["transfer_clean"] = True
+
+    # the failover half: kill the last replica mid-sweep (after its
+    # 2nd fused chunk) and require every request to complete anyway.
+    # UNWARMED on purpose: the crash fault compares against the
+    # engine's lifetime chunk counter, and a warmed victim would die
+    # on its first post-injection step — before the burst is
+    # mid-decode — making the zero-loss assertion trivially true
+    rs, queue = build(replicas, warm=False)
+    with faults.injected(fault_replica=replicas - 1,
+                         replica_crash_at_chunk=2):
+        handles = submit_burst(queue)
+        rs.run_until_idle()
+    ok = sum(h.result(timeout=60).status == "ok" for h in handles)
+    out["failover"] = {"requests": n_load, "completed": ok,
+                       "failovers": rs.failovers,
+                       "reclaimed": rs.reclaimed}
+    if rs.failovers < 1:
+        raise AssertionError("injected replica kill never fired — the "
+                             "failover leg proved nothing")
+    if ok != n_load:
+        raise AssertionError(
+            f"replica kill lost requests: {ok}/{n_load} completed")
+    return out
+
+
 def bench_serve(args):
     """Serving-path bench: the continuous-batching engine
     (dalle_pytorch_tpu/serve) under an offered-load sweep, swept over the
@@ -1490,6 +1613,21 @@ def bench_serve(args):
         kv_compare = {"error": f"{type(e).__name__}: {e}"}
         errors.append(str(e))
 
+    replica_compare = None
+    if args.replicas > 1:
+        _progress(f"serve: {args.replicas}-replica scaling + "
+                  f"injected-kill failover comparison")
+        try:
+            replica_compare = _serve_replica_compare(
+                params, cfg, replicas=args.replicas,
+                num_slots=num_slots, n_req=n_req, kv=kv,
+                page_size=page_size)
+        except Exception as e:  # noqa: BLE001 — same structured-error
+            # contract as the kv compare: the serve-faults CI smoke
+            # greps for it
+            replica_compare = {"error": f"{type(e).__name__}: {e}"}
+            errors.append(str(e))
+
     best = k_sweep[-1]["results"][-1]
     record = {
         "metric": "serve engine offered-load sweep (device-resident "
@@ -1504,6 +1642,8 @@ def bench_serve(args):
         "kv_budget_compare": kv_compare,
         "devices": len(jax.devices()), "backend": jax.default_backend(),
     }
+    if replica_compare is not None:
+        record["replica_compare"] = replica_compare
     if errors:
         record["error"] = "; ".join(errors)
     return record
@@ -1607,6 +1747,15 @@ def main():
                     help="bench_serve: KV page size for paged engines "
                          "(0 = 8 rows under --tiny so pages divide the "
                          "tiny seq exactly, else 16)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="bench_serve: also run the replica-set "
+                         "comparison at this many supervised engines "
+                         "behind one queue — asserts N-replica "
+                         "throughput beats 1-replica at the same "
+                         "offered load (transfer-clean, one decode "
+                         "compile per replica) and that an injected "
+                         "mid-sweep replica kill completes every "
+                         "request via failover replay")
     args = ap.parse_args()
     if args.gen_quant and args.no_gen:
         ap.error("--gen_quant needs the generate half; drop --no_gen")

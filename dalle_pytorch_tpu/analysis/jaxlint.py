@@ -10,9 +10,16 @@ syncs as the silent TPU killers; both are exactly the class of defect an
 AST pass can catch before anything is compiled. docs/STATIC_ANALYSIS.md
 is the rule catalog with one real bug from this repo's history per rule.
 
-Scope and philosophy: per-file analysis, tuned to THIS codebase's idioms
+Scope and philosophy: per-file analysis tuned to THIS codebase's idioms
 (``jax.jit(self._method)``, ``fn = jax.jit(pre)`` caches, bench's
-``run = jax.jit(...)`` timing harness). Rules prefer missing a finding
+``run = jax.jit(...)`` timing harness), plus PROJECT MODE
+(``lint_files`` — what the CLI and the repo-clean test run): JL001/JL009
+traced reachability propagates across module boundaries, so a
+module-level jitted program imported elsewhere is a known jitted
+callable there (host round-trips on its outputs are flagged), and a
+function jitted from ANOTHER module gets its body checked as traced
+code (the serve replica layer driving jitted engine internals is the
+motivating shape). Rules prefer missing a finding
 over flagging working idioms — the gate only stays on in CI if the
 merged tree lints clean. Every finding can be silenced in place with
 
@@ -191,6 +198,14 @@ class _ModuleIndex(ast.NodeVisitor):
         # from a jit expression anywhere in the module, with donated
         # positions when statically known
         self.jitted_names: Dict[str, Tuple[int, ...]] = {}
+        # MODULE-LEVEL jit assignments only — the importable subset, what
+        # project mode exports to other modules' jitted_names
+        self.module_jitted: Dict[str, Tuple[int, ...]] = {}
+        # cross-module resolution surface: `from M import n as a` ->
+        # import_from[a] = (M, n); module-object aliases (`import m.x
+        # as y`, `from pkg import mod`) -> module_alias[y] = dotted
+        self.import_from: Dict[str, Tuple[str, str]] = {}
+        self.module_alias: Dict[str, str] = {}
         self._fn_stack: List[ast.AST] = []
 
     # -- imports -----------------------------------------------------------
@@ -203,6 +218,10 @@ class _ModuleIndex(ast.NodeVisitor):
                 self.time_aliases.add(alias)
             elif a.name == "jax.random" and a.asname:
                 self.random_aliases.add(a.asname)
+            if a.asname:
+                self.module_alias[a.asname] = a.name
+            else:
+                self.module_alias[alias] = alias
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -210,6 +229,17 @@ class _ModuleIndex(ast.NodeVisitor):
             for a in node.names:
                 if a.name == "random":
                     self.random_aliases.add(a.asname or "random")
+        mod = node.module or ""
+        for a in node.names:
+            if a.name == "*":
+                continue
+            alias = a.asname or a.name
+            # a `from pkg import name` is ambiguous between a symbol
+            # and a submodule — record both readings; project mode
+            # resolves against what the target module actually exports
+            self.import_from[alias] = (mod, a.name)
+            self.module_alias[alias] = f"{mod}.{a.name}" if mod \
+                else a.name
         self.generic_visit(node)
 
     # -- defs --------------------------------------------------------------
@@ -242,6 +272,8 @@ class _ModuleIndex(ast.NodeVisitor):
                 name = _last(tgt)
                 if name:
                     self.jitted_names[name] = donated
+                    if not self._fn_stack:
+                        self.module_jitted[name] = donated
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
@@ -259,10 +291,9 @@ class _ModuleIndex(ast.NodeVisitor):
             if fn.name == name:
                 self.trace_roots.add(fn)
 
-    def finalize(self, tree: ast.Module) -> None:
+    def resolve(self, tree: ast.Module) -> None:
         """Late `jax.jit(name)` references may precede the def in visit
-        order; re-resolve every wrapper reference, then propagate traced
-        reachability through same-module calls and nesting."""
+        order; re-resolve every wrapper reference."""
         for node in ast.walk(tree):
             if isinstance(node, ast.Call) \
                     and _last(node.func) in _TRACE_WRAPPERS:
@@ -270,6 +301,17 @@ class _ModuleIndex(ast.NodeVisitor):
                     ref = _last(arg)
                     if ref:
                         self._mark_by_name(ref)
+
+    def mark_name(self, name: str) -> None:
+        """Mark a function DEFINED in this module as a trace root — the
+        project-mode entry for cross-module traced reachability (module
+        B jits a function module A defines)."""
+        self._mark_by_name(name)
+
+    def propagate(self) -> None:
+        """Propagate traced reachability through same-module calls and
+        nesting (re-runnable: project mode adds cross-module roots after
+        the per-module pass, then propagates again)."""
         by_name: Dict[str, List[ast.AST]] = {}
         for fn in self.functions:
             by_name.setdefault(fn.name, []).append(fn)
@@ -293,6 +335,10 @@ class _ModuleIndex(ast.NodeVisitor):
                             if fn not in self.trace_roots:
                                 self.trace_roots.add(fn)
                                 changed = True
+
+    def finalize(self, tree: ast.Module) -> None:
+        self.resolve(tree)
+        self.propagate()
 
 
 # ---------------------------------------------------------------------------
@@ -949,11 +995,8 @@ def _check_wallclock(idx: _ModuleIndex, path: str, tree: ast.Module,
 DEFAULT_EXCLUDES = ("fixtures/jaxlint",)
 
 
-def lint_source(src: str, path: str = "<string>") -> List[Finding]:
-    tree = ast.parse(src, filename=path)
-    idx = _ModuleIndex()
-    idx.visit(tree)
-    idx.finalize(tree)
+def _run_checks(idx: _ModuleIndex, path: str,
+                tree: ast.Module) -> List[Finding]:
     findings: List[Finding] = []
     traced_spans = [(fn.lineno, max(getattr(fn, "end_lineno", fn.lineno),
                                     fn.lineno))
@@ -966,7 +1009,10 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
     _check_loop_closures(idx, path, tree, findings)
     _check_use_after_donate(idx, path, findings)
     _check_wallclock(idx, path, tree, traced_spans, findings)
+    return findings
 
+
+def _filter(findings: List[Finding], src: str) -> List[Finding]:
     supp = _suppressions(src)
     findings = [f for f in findings
                 if f.rule not in supp.get(f.line, set())]
@@ -982,9 +1028,134 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
     return out
 
 
+def lint_source(src: str, path: str = "<string>") -> List[Finding]:
+    tree = ast.parse(src, filename=path)
+    idx = _ModuleIndex()
+    idx.visit(tree)
+    idx.finalize(tree)
+    return _filter(_run_checks(idx, path, tree), src)
+
+
 def lint_file(path: Path) -> List[Finding]:
     src = path.read_text(encoding="utf-8")
     return lint_source(src, str(path))
+
+
+# ---------------------------------------------------------------------------
+# project mode: cross-module traced reachability (JL001/JL009)
+# ---------------------------------------------------------------------------
+
+def _mod_parts(path: str) -> Tuple[str, ...]:
+    """Dotted-module parts of a file path ('.../serve/engine.py' ->
+    (..., 'serve', 'engine')); a package's __init__.py is the package
+    itself."""
+    p = Path(path)
+    parts = list(p.parts)
+    parts[-1] = p.stem
+    if parts[-1] == "__init__":
+        parts.pop()
+    return tuple(parts)
+
+
+class _Unit:
+    __slots__ = ("path", "src", "tree", "idx", "parts")
+
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.src = src
+        self.tree = ast.parse(src, filename=path)
+        self.idx = _ModuleIndex()
+        self.idx.visit(self.tree)
+        self.idx.resolve(self.tree)
+        self.parts = _mod_parts(path)
+
+
+def _find_unit(units: List[_Unit], modref: str,
+               importer: Optional[_Unit] = None) -> Optional[_Unit]:
+    """The linted module an import path refers to, by longest suffix
+    match on dotted parts (absolute `pkg.sub.mod`, relative `.mod`, and
+    sibling `mod` all resolve). Conservative on two fronts: ambiguity
+    (two equally-specific candidates) resolves to None, and a match on
+    the BARE module name alone (one component) binds only a same-
+    directory sibling of the importer — `from engine import run` in an
+    unrelated script must not bind to some package's engine.py and
+    plant phantom trace roots there."""
+    parts = tuple(p for p in modref.split(".") if p)
+    if not parts:
+        return None
+    best: List[_Unit] = []
+    best_k = 0
+    for u in units:
+        k = min(len(parts), len(u.parts))
+        if k and parts[-k:] == u.parts[-k:]:
+            if k == 1 and len(parts) == 1 and importer is not None \
+                    and u.parts[:-1] != importer.parts[:-1]:
+                continue
+            if k > best_k:
+                best, best_k = [u], k
+            elif k == best_k:
+                best.append(u)
+    return best[0] if len(best) == 1 else None
+
+
+def _cross_link(units: List[_Unit]) -> None:
+    """The cross-module pass. Two propagations per importing module:
+
+      * jitted NAMES — `from mod import fused_step` where ``fused_step``
+        is a module-level jit assignment in a linted module makes the
+        alias a known jitted callable here, so JL001's round-trip half
+        and JL009's eager-control half see host syncs on its outputs
+        across the file boundary (the replica layer calling jitted
+        engine internals is exactly this shape);
+      * trace ROOTS — `jax.jit(helper)` / `lax.scan(mod.fn, ...)` where
+        the function is DEFINED in another linted module marks that def
+        a trace root over there, so JL001/JL002/JL008 check its body as
+        traced code even though the jit() lives here."""
+    for u in units:
+        for alias, (modref, orig) in u.idx.import_from.items():
+            t = _find_unit(units, modref, importer=u)
+            if t is not None and orig in t.idx.module_jitted:
+                u.idx.jitted_names.setdefault(
+                    alias, t.idx.module_jitted[orig])
+        for node in ast.walk(u.tree):
+            if not (isinstance(node, ast.Call)
+                    and _last(node.func) in _TRACE_WRAPPERS):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name) \
+                        and arg.id in u.idx.import_from:
+                    modref, orig = u.idx.import_from[arg.id]
+                    t = _find_unit(units, modref, importer=u)
+                    if t is not None:
+                        t.idx.mark_name(orig)
+                elif isinstance(arg, ast.Attribute):
+                    modref = u.idx.module_alias.get(_dotted(arg.value))
+                    if modref:
+                        t = _find_unit(units, modref, importer=u)
+                        if t is not None:
+                            t.idx.mark_name(arg.attr)
+
+
+def _lint_units(units: List[_Unit]) -> List[Finding]:
+    """The shared project-mode body: cross-link, propagate, check."""
+    _cross_link(units)
+    findings: List[Finding] = []
+    for u in units:
+        u.idx.propagate()
+        findings.extend(_filter(_run_checks(u.idx, u.path, u.tree),
+                                u.src))
+    return findings
+
+
+def lint_files(paths: Sequence[Path]) -> List[Finding]:
+    """Project mode: lint every file with cross-module traced
+    reachability (what ``main`` and the repo-clean test run). Per-file
+    semantics are unchanged — the cross pass only ADDS knowledge, so a
+    file clean here is clean solo plus clean against its imports. An
+    unparseable file raises SyntaxError up front, before any work
+    (``main`` reports parse errors per file and lints the rest)."""
+    return _lint_units([_Unit(str(p), p.read_text(encoding="utf-8"))
+                        for p in paths])
 
 
 def iter_py_files(paths: Sequence[str],
@@ -1043,15 +1214,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print("jaxlint: no python files found", file=sys.stderr)
         return 2
 
-    findings: List[Finding] = []
+    # project mode: parse everything first, then lint with cross-module
+    # traced reachability (unparseable files are reported and skipped)
+    units: List[_Unit] = []
     errors = 0
     for f in files:
         try:
-            findings.extend(lint_file(f))
+            units.append(_Unit(str(f), f.read_text(encoding="utf-8")))
         except SyntaxError as e:
             errors += 1
             print(f"{f}:{e.lineno or 0}:0: parse error: {e.msg}",
                   file=sys.stderr)
+    findings = _lint_units(units)
     if select:
         findings = [f for f in findings if f.rule in select]
     if ignore:

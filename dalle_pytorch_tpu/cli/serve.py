@@ -83,6 +83,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "defers on page pressure and mid-decode "
                         "exhaustion evicts the lowest-priority request "
                         "back to the queue")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="engine replicas behind the one queue (each its "
+                        "own thread and, with multiple devices, its own "
+                        "chip). A replica that crashes or hangs is "
+                        "fenced and its in-flight requests replay on a "
+                        "survivor with bit-identical tokens — zero "
+                        "requests lost (docs/SERVING.md 'Replica set & "
+                        "failover')")
+    p.add_argument("--heartbeat_s", type=float, default=5.0,
+                   help="replica hang detection: a replica whose "
+                        "serving loop misses heartbeats for this long "
+                        "is fenced and failed over (replicas > 1 only). "
+                        "Set it well above your worst-case fused-chunk "
+                        "time (chunks are O(10ms); too tight and a "
+                        "slow harvest reads as a hang -> needless "
+                        "failover churn)")
     p.add_argument("--queue_depth", type=int, default=64,
                    help="bounded admission queue; submissions past this "
                         "are rejected with a structured 429")
@@ -161,13 +177,14 @@ def main(argv=None):
         prefill_buckets=buckets,
         quantize_cache=args.quantize == "int8_kv",
         kv=args.kv, page_size=args.page_size, num_pages=args.num_pages,
+        replicas=args.replicas, heartbeat_s=args.heartbeat_s,
         clip_params=clip_params, clip_cfg=clip_cfg, metrics=metrics,
         log_every=args.log_every, encode=vocab.encode,
         init_deadline_s=args.init_deadline_s,
         init_retries=args.init_retries).start()
     say(f"serving {dalle_path} on http://{args.host}:{args.port} "
-        f"({args.num_slots} slots, K={args.chunk_steps}, "
-        f"kv={args.kv}, queue {args.queue_depth})")
+        f"({args.replicas} replica(s) x {args.num_slots} slots, "
+        f"K={args.chunk_steps}, kv={args.kv}, queue {args.queue_depth})")
     serve_http(server, args.host, args.port)
 
 

@@ -15,6 +15,8 @@ Simulated faults (pytest -m faults exercises each):
   * crashing data iterator             -> crashing_iterator (test helper)
   * truncated / corrupt checkpoints    -> truncate_params / remove_manifest
                                           / simulate_interrupted_save
+  * serving replica crash / hang       -> on_replica_chunk
+  * flaky replica bring-up             -> on_replica_bringup
 """
 
 from __future__ import annotations
@@ -47,6 +49,19 @@ class FaultPlan:
     # has no float leaves to poison (train_dalle/train_clip's integer
     # token ids), where nan_at_step raises instead of firing
     nan_loss_at_step: int = -1
+    # serving replica set (serve/replica.py): which replica index the
+    # serve-side faults below target, and the deterministic failure
+    # points — crash (raise out of the serving loop) or hang (stall the
+    # loop for replica_hang_s so the heartbeat deadline trips) once the
+    # replica has dispatched this many fused decode chunks, and/or fail
+    # its first replica_flaky_bringup bring-up attempts (the circuit-
+    # breaker path). Mirrors the train-side style: -1/0 = off, hooks
+    # no-ops without an active plan, crash/hang fire AT MOST ONCE.
+    fault_replica: int = 0
+    replica_crash_at_chunk: int = -1
+    replica_hang_at_chunk: int = -1
+    replica_hang_s: float = 30.0
+    replica_flaky_bringup: int = 0
 
 
 _active: Optional[FaultPlan] = None
@@ -167,6 +182,44 @@ def corrupt_loss(loss: float, step: int) -> float:
     if p is None or step != p.nan_loss_at_step or not _once("nan_loss"):
         return loss
     return float("nan")
+
+
+def on_replica_chunk(replica: int, chunk: int) -> None:
+    """Inside a replica's serving loop, before each engine step, with the
+    count of fused decode chunks the replica has dispatched so far.
+    ``replica_crash_at_chunk=N`` raises (the loop dies and the supervisor
+    must fence + reclaim + replay); ``replica_hang_at_chunk=N`` sleeps
+    ``replica_hang_s`` OUTSIDE the engine lock (the heartbeat stalls
+    exactly as it would on a wedged device sync, and the supervisor must
+    fence the replica without the wedged thread's cooperation). Both
+    target ``fault_replica`` only and fire at most once."""
+    p = _active
+    if p is None or replica != p.fault_replica:
+        return
+    if p.replica_crash_at_chunk >= 0 \
+            and chunk >= p.replica_crash_at_chunk \
+            and _once("replica_crash"):
+        raise FaultInjected(
+            f"injected replica {replica} crash at chunk {chunk}")
+    if p.replica_hang_at_chunk >= 0 \
+            and chunk >= p.replica_hang_at_chunk \
+            and _once("replica_hang"):
+        time.sleep(p.replica_hang_s)
+
+
+def on_replica_bringup(replica: int, attempt: int) -> None:
+    """Inside the replica supervisor's bring-up path: fail attempts
+    ``< replica_flaky_bringup`` of ``fault_replica``'s lifetime bring-up
+    count — the circuit-breaker exercise (repeated failure backs the
+    replica off with exponential delays; the set degrades gracefully
+    until the attempt that succeeds re-joins it to routing)."""
+    p = _active
+    if p is None or replica != p.fault_replica:
+        return
+    if attempt < p.replica_flaky_bringup:
+        raise FaultInjected(
+            f"injected replica {replica} bring-up failure "
+            f"(attempt {attempt})")
 
 
 # ---------------------------------------------------------------------------

@@ -21,6 +21,9 @@ def __getattr__(name):
     if name == "Engine":
         from dalle_pytorch_tpu.serve.engine import Engine
         return Engine
+    if name == "ReplicaSet":
+        from dalle_pytorch_tpu.serve.replica import ReplicaSet
+        return ReplicaSet
     if name == "PostProcessor":
         from dalle_pytorch_tpu.serve.postprocess import PostProcessor
         return PostProcessor

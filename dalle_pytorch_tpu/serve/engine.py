@@ -131,12 +131,21 @@ class Engine:
                  kv: str = "dense",
                  page_size: int = 0,
                  num_pages: int = 0,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 device=None):
         import jax
         import jax.numpy as jnp
 
         from dalle_pytorch_tpu.ops import decode as decode_ops
 
+        # replica placement: committing the params pins every program
+        # this engine runs (and, transitively, all its decode state) to
+        # ONE device, so a ReplicaSet can put each replica on its own
+        # chip and their chunk programs genuinely overlap. device=None
+        # (the single-engine default) keeps jax's default placement.
+        self.device = device
+        if device is not None:
+            params = jax.device_put(params, device)
         self.params = params
         self.cfg = cfg
         self.queue = queue
@@ -240,6 +249,18 @@ class Engine:
         self.temp = jnp.ones((S_,), jnp.float32)
         self.topk_k = jnp.ones((S_,), jnp.int32)
         self.top_p = jnp.zeros((S_,), jnp.float32)
+        if device is not None:
+            # commit the pool + per-slot state too: nothing this engine
+            # carries between chunks may sit on the default device for
+            # jit to migrate per call
+            (self.cache, self.key_mask, self.cur_tok, self.pos,
+             self.active, self.rng, self.temp, self.topk_k,
+             self.top_p) = jax.device_put(
+                (self.cache, self.key_mask, self.cur_tok, self.pos,
+                 self.active, self.rng, self.temp, self.topk_k,
+                 self.top_p), device)
+            if self.kv == "paged":
+                self.block_tables = jax.device_put(self._bt_host, device)
         self.slots: List[Optional[_Slot]] = [None] * S_
         self._pending: deque = deque()   # dispatched, un-harvested chunks
 
@@ -258,6 +279,33 @@ class Engine:
         self.occupancy_sum = 0
         self._t_start = None
         self._last_log = 0
+
+        # replica supervision surface (serve/replica.py): the heartbeat
+        # is stamped at every step and every harvest — a wedged device
+        # sync stops it advancing, which is how a hang is detected
+        # without touching the wedged thread. ``fenced`` is the one-way
+        # kill switch the supervisor flips BEFORE reclaiming this
+        # engine's in-flight requests: a fenced engine never fulfils a
+        # handle, hands a completion downstream, or re-queues anything
+        # — its requests belong to whoever fenced it.
+        self.fenced = False
+        self.last_heartbeat = self.clock()
+        # True while a KNOWN first call of a jitted program is tracing/
+        # compiling (cold prefill bucket, first decode chunk): compiles
+        # take seconds on a cold cache, and the supervisor must not
+        # read the stalled heartbeat as a hang and fence a healthy
+        # replica mid-compile
+        self.compiling = False
+        # a fenced engine mid-step may hold handles it just popped that
+        # are in neither its queue nor its slots; this hook (set by the
+        # replica supervisor) returns them to the shared queue instead
+        # of dropping them
+        self.on_fenced_orphan: Optional[Callable] = None
+        # handles popped from the queue but not yet slotted — published
+        # BEFORE admission so a reclaim sweep can see work held by a
+        # thread wedged inside the admission prefill (a cold compile
+        # blocks for seconds with these in step locals)
+        self._admitting: List[S.RequestHandle] = []
 
         # donating the cache lets XLA update the K/V buffers in place
         # per chunk instead of copying them
@@ -404,7 +452,57 @@ class Engine:
 
     # -- request lifecycle --------------------------------------------------
 
+    def fence(self) -> None:
+        """One-way kill switch (replica failover / operator drain): after
+        this, the engine drops every completion/expiry/error instead of
+        fulfilling it, skips every requeue, and ``step_once`` bails on
+        entry. Set by the supervisor BEFORE it reclaims this engine's
+        in-flight handles, so a wedged thread waking mid-step cannot race
+        the replay with a stale result (``RequestHandle.fulfill`` being
+        first-write-wins is the belt to this suspender)."""
+        self.fenced = True
+
+    def inflight_handles(self) -> List[S.RequestHandle]:
+        """Host-side snapshot of every request this engine holds: the
+        in-slot handles plus any mid-admission ones (popped, published
+        in ``_admitting``, not yet slotted) — the failover reclaim
+        surface. Pure bookkeeping (no device sync), so a supervisor can
+        read it even while the engine thread is wedged inside a chunk
+        or an admission compile."""
+        out: List[S.RequestHandle] = []
+        seen: set = set()
+        for h in [s.handle for s in list(self.slots) if s is not None] \
+                + list(self._admitting):
+            rid = h.request.request_id
+            if rid not in seen:
+                seen.add(rid)
+                out.append(h)
+        return out
+
+    def _orphan_handles(self, handles) -> None:
+        """Hand fenced-mid-step handles back to the supervisor (they
+        are in neither this engine's queue nor its slots, so the
+        reclaim sweep cannot see them) — the ONE definition of the
+        fence-orphan contract, shared by every admission bail-out."""
+        for h in handles:
+            if not h.done() and self.on_fenced_orphan is not None:
+                self.on_fenced_orphan(h)
+
+    def _requeue_or_orphan(self, handle: S.RequestHandle) -> None:
+        """Return a handle to the line: via this engine's own queue
+        normally, via the supervisor's orphan hook once fenced — the
+        fence may land MID-STEP (after the entry checks, while a device
+        op blocks), and by then the private queue is drained, so its
+        ``requeue`` would fulfil the handle ``cancelled`` and race the
+        failover replay with a spurious terminal result."""
+        if self.fenced:
+            self._orphan_handles([handle])
+            return
+        self.queue.requeue(handle)
+
     def _finish(self, handle: S.RequestHandle, result: S.Result) -> None:
+        if self.fenced:
+            return
         if result.status == S.OK and self.complete is not None:
             self.complete(handle, result)
         else:
@@ -438,6 +536,13 @@ class Engine:
 
     def _admit(self, handles: List[S.RequestHandle], now: float) -> None:
         import jax
+        if self.fenced:
+            # fenced mid-step after the pop: these handles are in
+            # neither the queue nor a slot, so the reclaim sweep cannot
+            # see them — hand them back to the shared queue (replay)
+            # rather than dropping them on the floor
+            self._orphan_handles(handles)
+            return
         free = [i for i, s in enumerate(self.slots) if s is None]
         assert len(handles) <= len(free)
         valid = []
@@ -477,7 +582,7 @@ class Engine:
                     # head-of-line block: requeue this and every later
                     # pop (arrival order preserved by queue_seq)
                     for hh in valid[k:]:
-                        self.queue.requeue(hh)
+                        self._requeue_or_orphan(hh)
                     self._hol_rid = rid
                     self._hol_need = need
                     # a waiting request is re-popped once it could fit;
@@ -502,6 +607,12 @@ class Engine:
                 grants[rid] = self.alloc.alloc(need)
             valid = fits
         for bucket, group in S.group_by_bucket(valid, self.buckets).items():
+            if self.fenced:
+                # fenced between groups: the rest of the admission is
+                # step locals the reclaim sweep cannot see — orphan
+                # them back to the shared queue
+                self._orphan_handles(group)
+                continue
             idx = free[:len(group)]
             free = free[len(group):]
             G = self.num_slots
@@ -543,16 +654,26 @@ class Engine:
             try:
                 # explicit-transfer discipline: the admission path's
                 # host->device traffic is device_put at the site, never
-                # implicit conversion (guards.no_transfers-clean)
-                outs = self._prefill_fn(bucket)(
-                    self.params, self.cache, self.cur_tok, self.pos,
-                    self.active, self.rng, self.temp, self.topk_k,
-                    self.top_p, jax.device_put(text),
-                    jax.device_put(lens), jax.device_put(slots),
-                    jax.device_put(n_seed), jax.device_put(n_temp),
-                    jax.device_put(n_topk), jax.device_put(n_top_p),
-                    **({"page_rows": jax.device_put(page_rows)}
-                       if self.kv == "paged" else {}))
+                # implicit conversion (guards.no_transfers-clean).
+                # device=None is jax's default placement; a placed
+                # replica ships straight to its own chip
+                put = lambda a: jax.device_put(a, self.device)  # noqa: E731
+                cold = bucket not in self._prefill_fns
+                if cold:
+                    self.compiling = True
+                try:
+                    outs = self._prefill_fn(bucket)(
+                        self.params, self.cache, self.cur_tok, self.pos,
+                        self.active, self.rng, self.temp, self.topk_k,
+                        self.top_p, put(text), put(lens), put(slots),
+                        put(n_seed), put(n_temp), put(n_topk),
+                        put(n_top_p),
+                        **({"page_rows": put(page_rows)}
+                           if self.kv == "paged" else {}))
+                finally:
+                    if cold:
+                        self.compiling = False
+                        self.last_heartbeat = self.clock()
             except Exception as e:  # noqa: BLE001 — no-hangs contract
                 # the group's slots were never assigned (still None) and
                 # the device state is rebound only on success below, so
@@ -566,6 +687,15 @@ class Engine:
                     self._bt_dirty = True
                 for h in group:
                     self._error(h, now, f"prefill failed: {e!r}")
+                continue
+            if self.fenced:
+                # fence landed DURING the prefill call (a cold compile
+                # is seconds long — exactly where a supervisor's hang
+                # deadline can fire): the reclaim sweep could not see
+                # this group (neither queued nor slotted, just step
+                # locals), so hand it back to the shared queue instead
+                # of slotting it into a dead engine
+                self._orphan_handles(group)
                 continue
             (self.cache, self.cur_tok, self.pos, self.active, self.rng,
              self.temp, self.topk_k, self.top_p) = outs
@@ -610,6 +740,8 @@ class Engine:
         latency, never correctness. Returns False when no slot is
         active."""
         import jax
+        if self.fenced:
+            return False    # the reclaim sweep owns every in-slot handle
         cand = [(s.handle.request.priority, s.t_admit, i)
                 for i, s in enumerate(self.slots) if s is not None]
         if not cand:
@@ -620,7 +752,8 @@ class Engine:
         self._free_slot(i)
         keep = np.ones((self.num_slots,), bool)
         keep[i] = False
-        self.active = self._kill_fn(self.active, jax.device_put(keep))
+        self.active = self._kill_fn(self.active,
+                                    jax.device_put(keep, self.device))
         self.evicted += 1
         # un-credit the victim's harvested tokens: re-admission replays
         # them all, so leaving the prefix counted would inflate
@@ -629,7 +762,7 @@ class Engine:
         # orphaned mid-flight ring row)
         self.tokens_decoded -= len(slot.emitted)
         self.occupancy_sum -= len(slot.emitted)
-        self.queue.requeue(slot.handle)
+        self._requeue_or_orphan(slot.handle)
         if self.metrics is not None:
             self.metrics.event(**S.structured_event(
                 "serve_evict", request_id=slot.handle.request.request_id,
@@ -676,7 +809,7 @@ class Engine:
         only paged-specific host->device traffic in steady state."""
         import jax
         if self._bt_dirty:
-            self.block_tables = jax.device_put(self._bt_host)
+            self.block_tables = jax.device_put(self._bt_host, self.device)
             self._bt_dirty = False
 
     # -- the fused-chunk pipeline -------------------------------------------
@@ -686,18 +819,27 @@ class Engine:
         and queue its emit ring for a later harvest. No host sync here:
         the outputs are futures, and the device starts computing while
         the host goes on to admit/harvest."""
-        if self.kv == "paged":
-            self._map_ahead(now)
-            self._sync_block_tables()
-            self._pages_samples.append(self.alloc.in_use)
-            outs = self._decode_fn(self.params, self.cache,
-                                   self.block_tables, self.cur_tok,
-                                   self.pos, self.active, self.rng,
-                                   self.temp, self.topk_k, self.top_p)
-        else:
-            outs = self._decode_fn(self.params, self.cache, self.cur_tok,
-                                   self.pos, self.active, self.rng,
-                                   self.temp, self.topk_k, self.top_p)
+        cold = self.decode_traces == 0      # first call traces+compiles
+        if cold:
+            self.compiling = True
+        try:
+            if self.kv == "paged":
+                self._map_ahead(now)
+                self._sync_block_tables()
+                self._pages_samples.append(self.alloc.in_use)
+                outs = self._decode_fn(self.params, self.cache,
+                                       self.block_tables, self.cur_tok,
+                                       self.pos, self.active, self.rng,
+                                       self.temp, self.topk_k, self.top_p)
+            else:
+                outs = self._decode_fn(self.params, self.cache,
+                                       self.cur_tok, self.pos,
+                                       self.active, self.rng, self.temp,
+                                       self.topk_k, self.top_p)
+        finally:
+            if cold:
+                self.compiling = False
+                self.last_heartbeat = self.clock()
         self.cur_tok, self.pos, self.active, self.cache, ring = outs
         owners = [(i, s) for i, s in enumerate(self.slots)
                   if s is not None]
@@ -721,6 +863,11 @@ class Engine:
         ring, active_after = jax.device_get([rec.ring, rec.active])
         self.harvests += 1
         now = self.clock()
+        # the harvest's device_get is the one blocking sync in steady
+        # state — exactly where a wedged device stalls the thread, so
+        # stamping the heartbeat here makes the supervisor's missed-
+        # heartbeat deadline measure real progress, not loop liveness
+        self.last_heartbeat = now
         emitted = 0
         for i, slot in rec.owners:
             if slot.handle.done() or self.slots[i] is not slot:
@@ -780,7 +927,10 @@ class Engine:
         ``analysis.guards.no_transfers()``."""
         import jax
         with self._lock:
+            if self.fenced:
+                return False        # reclaimed: this pool is dead weight
             now = self.clock()
+            self.last_heartbeat = now
             if self._t_start is None:
                 self._t_start = now
 
@@ -801,8 +951,8 @@ class Engine:
             if kill:
                 keep = np.ones((self.num_slots,), bool)
                 keep[kill] = False
-                self.active = self._kill_fn(self.active,
-                                            jax.device_put(keep))
+                self.active = self._kill_fn(
+                    self.active, jax.device_put(keep, self.device))
                 did = True
 
             free = self.num_slots - self.active_slots()
@@ -825,7 +975,13 @@ class Engine:
                         self._hol_rid = None
                         self._hol_need = 0
             if ready:
-                self._admit(ready, now)
+                # published for the reclaim sweep BEFORE admission can
+                # block on a compile (see _admitting)
+                self._admitting = list(ready)
+                try:
+                    self._admit(ready, now)
+                finally:
+                    self._admitting = []
             did = did or bool(ready or expired)
 
             dispatched = False
@@ -902,6 +1058,8 @@ class Engine:
         consistent continuation is an empty pool and an empty pipeline).
         Returns the number terminated."""
         import jax.numpy as jnp
+        if self.fenced:
+            return 0        # the reclaim sweep owns the in-slot handles
         n = 0
         with self._lock:
             now = self.clock()

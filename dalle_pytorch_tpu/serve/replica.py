@@ -1,0 +1,707 @@
+"""Replica-set serving: N supervised engines behind ONE queue, with
+zero-loss failover via deterministic replay.
+
+One ``Engine`` is one replica: one compiled decode program over one slot
+pool on (ideally) one chip. This module is the layer the ROADMAP's
+multi-replica item asks for — a single shared ``RequestQueue`` fronting N
+engines (thread-per-engine; the Gemma-on-TPU serving paper's replicated-
+engine + health-driven-routing shape, PAPERS.md), where a replica
+crashing, hanging, or being drained by an operator costs LATENCY on the
+requests it held, never a lost request and never a wrong token.
+
+The key enabler is the same one paged eviction proved (PR 5): sampling
+is deterministic in (seed, position) — ``fold_in(request_rng, pos)`` per
+step — so an in-flight request is *migratable*. Kill the replica mid-
+stream, re-queue the handle at its ORIGINAL arrival position
+(``RequestQueue.requeue`` preserves ``queue_seq``), admit it on a
+survivor, and the replay emits a token stream bit-identical to an
+undisturbed run. The caller cannot tell a failover happened except by
+the clock.
+
+Supervision (one supervisor per set, not per request):
+
+  * every replica's serving loop stamps ``Engine.last_heartbeat`` at
+    each step and each emit-ring harvest — the harvest ``device_get``
+    is the one blocking sync in steady state, so a wedged device stalls
+    the stamp exactly where the wedge is;
+  * CRASH: the replica loop catches the exception, records it, and
+    exits; the supervisor notices the dead loop.
+    HANG: ``now - last_heartbeat > heartbeat_s`` while the loop thread
+    is still "running". Either way the replica is FENCED
+    (``Engine.fence()`` — a fenced engine never fulfils a handle, hands
+    a completion downstream, or re-queues anything; the wedged thread
+    is abandoned, daemon-style, the same move ``resilience.retry``
+    makes for an uncancellable pending claim);
+  * RECLAIM: the supervisor snapshots the fenced replica's host-side
+    bookkeeping — its private queue (routed, not yet admitted) and its
+    in-slot handles (``Engine.inflight_handles``) — and re-queues every
+    not-yet-done handle into the shared queue at its original arrival
+    position for replay. ``RequestHandle.fulfill`` is first-write-wins,
+    so even a fenced thread waking at the worst moment cannot race the
+    replay with a stale result;
+  * BRING-UP: the replica is rebuilt (fresh ``Engine``, fresh private
+    queue). Repeated bring-up failure circuit-breaks the replica with
+    exponential backoff (``resilience.retry.RetryPolicy.backoff``)
+    while the set keeps serving on the survivors — capacity shrinks,
+    the shared queue's ``max_depth`` turns the shrinkage into typed
+    ``QueueFull`` backpressure at submit, and nothing ever hangs;
+  * DRAIN: ``drain_replica(i)`` is the operator's planned-maintenance
+    path — identical fence + reclaim, but the replica stays down until
+    ``undrain_replica(i)``.
+
+Routing is least-loaded with page-awareness: the router moves requests
+from the shared queue into per-replica private queues (``requeue`` with
+``count=False`` — a hand-off, not backpressure; the handle keeps its
+shared-queue ``queue_seq`` and ``request_id``), preferring the replica
+with the most free slot capacity and, among paged engines, one whose
+page pool can map the request's prompt span NOW (free pages from the
+replica's kv-pool stats break ties).
+
+Like ``Engine``, the set is drivable two ways: ``step_once``/
+``run_until_idle`` single-threaded (tests, bench — deterministic, and
+the whole steady state still holds under ``guards.no_transfers`` with
+one decode compile per replica), or ``start()`` for live traffic
+(thread per replica + one control thread for routing/supervision, what
+``serve.server`` uses). With more than one jax device visible, replica
+i's engine is committed to device ``i % len(devices)`` so the replicas'
+fused chunks genuinely overlap — on a pod slice that is replica-per-
+chip serving; on the CPU fallback it still overlaps the async dispatch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from dalle_pytorch_tpu.serve import scheduler as S
+
+# replica lifecycle states (``replica_states()`` / ``stats()``)
+RUNNING = "running"
+BROKEN = "broken"        # circuit open: waiting out the bring-up backoff
+DRAINED = "drained"      # operator drain: down until undrain_replica()
+
+_COUNTERS = ("tokens_decoded", "decode_steps", "harvests",
+             "occupancy_sum", "completed", "expired",
+             "decode_traces", "prefill_traces", "evicted")
+
+
+class _Replica:
+    """One supervised slot of the set: the engine + its private queue,
+    its loop thread (threaded mode), and the supervisor's bookkeeping
+    (lifecycle state, consecutive bring-up failures, backoff clock)."""
+
+    __slots__ = ("index", "state", "engine", "queue", "thread", "stop",
+                 "device", "attempt", "bringups", "next_bringup_t",
+                 "last_error", "dead")
+
+    def __init__(self, index: int, device=None):
+        self.index = index
+        self.state = BROKEN          # until the first bring-up succeeds
+        self.engine = None
+        self.queue: Optional[S.RequestQueue] = None
+        self.thread: Optional[threading.Thread] = None
+        self.stop: Optional[threading.Event] = None
+        self.device = device
+        self.attempt = 0             # consecutive bring-up failures
+        self.bringups = 0            # lifetime bring-up calls (faults)
+        self.next_bringup_t = 0.0
+        self.last_error = ""
+        self.dead = False            # loop thread recorded a crash
+
+
+class ReplicaSet:
+    """N supervised ``Engine`` replicas behind one shared
+    ``scheduler.RequestQueue``. Presents the same drive surface as a
+    single engine (``step_once`` / ``run_until_idle`` / ``idle`` /
+    ``stats`` plus the counters ``bench._serve_load_point`` reads), so
+    everything that can drive an engine can drive a set."""
+
+    def __init__(self, params: dict, cfg, queue: S.RequestQueue, *,
+                 replicas: int = 2,
+                 num_slots: int = 4,
+                 chunk_steps: int = 8,
+                 prefill_buckets=None,
+                 complete: Optional[Callable] = None,
+                 metrics=None, log_every: int = 0,
+                 quantize_cache: bool = False,
+                 kv: str = "dense",
+                 page_size: int = 0,
+                 num_pages: int = 0,
+                 clock: Callable[[], float] = time.perf_counter,
+                 heartbeat_s: float = 5.0,
+                 bringup_policy=None,
+                 place_on_devices: bool = True,
+                 idle_sleep_s: float = 0.002):
+        import jax
+
+        from dalle_pytorch_tpu.resilience import retry as rretry
+
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.params = params
+        self.cfg = cfg
+        self.queue = queue
+        self.n_replicas = int(replicas)
+        self.complete = complete
+        self.metrics = metrics
+        self.clock = clock
+        self.heartbeat_s = float(heartbeat_s)
+        self.kv = str(kv)
+        self._engine_kwargs = dict(
+            num_slots=num_slots, chunk_steps=chunk_steps,
+            prefill_buckets=prefill_buckets, metrics=metrics,
+            log_every=log_every, quantize_cache=quantize_cache,
+            kv=kv, page_size=page_size, num_pages=num_pages)
+        # circuit-breaker backoff between bring-up attempts; serving
+        # wants short first retries and a firm cap, not training's
+        # minutes-scale defaults
+        self.bringup_policy = bringup_policy or rretry.RetryPolicy(
+            max_attempts=1, deadline_s=None, base_backoff_s=0.5,
+            backoff_multiplier=2.0, max_backoff_s=30.0, jitter=0.0)
+        self._idle_sleep_s = float(idle_sleep_s)
+
+        devices = jax.devices()
+        self._placed = place_on_devices and len(devices) > 1
+        self.replicas: List[_Replica] = []
+        for i in range(self.n_replicas):
+            dev = devices[i % len(devices)] if self._placed else None
+            self.replicas.append(_Replica(i, device=dev))
+
+        # supervisor counters + retired-engine counter base: a fenced
+        # engine's numbers are folded in here at reclaim time (minus the
+        # reclaimed requests' harvested prefixes — replay re-credits
+        # every token, the same distinct-delivered-tokens discipline as
+        # paged eviction), so the set's aggregates survive failovers
+        self._retired = {k: 0 for k in _COUNTERS}
+        self.failovers = 0
+        self.reclaimed = 0
+        self.expired = 0             # router-side queued-deadline reaps
+        self.bringup_failures = 0
+        self._ctl_lock = threading.Lock()
+        self._started = False
+        self._ctl_thread: Optional[threading.Thread] = None
+        self._ctl_stop = threading.Event()
+        self._t_start: Optional[float] = None
+
+        now = self.clock()
+        for r in self.replicas:
+            self._bring_up(r, now)
+
+    # -- events -------------------------------------------------------------
+
+    def _event(self, kind: str, **fields) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.event(**S.structured_event(kind, **fields))
+            except Exception:   # noqa: BLE001 — observability must never
+                pass            # take down supervision
+
+    # -- bring-up / circuit breaker -----------------------------------------
+
+    def _bring_up(self, r: _Replica, now: float) -> bool:
+        """One bring-up attempt: fresh private queue + fresh Engine (the
+        old pair, if any, was fenced and drained at reclaim — reusing
+        the drained queue would cancel the NEW engine's evictions).
+        Failure schedules the next attempt with exponential backoff;
+        the replica stays circuit-broken (BROKEN) in between."""
+        from dalle_pytorch_tpu.resilience import faults
+        from dalle_pytorch_tpu.serve.engine import Engine
+
+        attempt = r.bringups
+        r.bringups += 1
+        try:
+            faults.on_replica_bringup(r.index, attempt)
+            queue = S.RequestQueue(
+                max_depth=4 * self._engine_kwargs["num_slots"] + 8,
+                clock=self.clock)
+            engine = Engine(self.params, self.cfg, queue,
+                            complete=self.complete, clock=self.clock,
+                            device=r.device, **self._engine_kwargs)
+        except Exception as e:  # noqa: BLE001 — circuit-break, don't die
+            r.attempt += 1
+            self.bringup_failures += 1
+            delay = self.bringup_policy.backoff(min(r.attempt - 1, 20))
+            r.next_bringup_t = now + delay
+            r.last_error = repr(e)
+            r.state = BROKEN
+            self._event("serve_replica_bringup_fail", replica=r.index,
+                        attempt=attempt, consecutive=r.attempt,
+                        backoff_s=round(delay, 3), error=repr(e))
+            return False
+        # an orphan is a handle the fenced engine popped but never
+        # admitted (fence landed mid-step): back to the shared queue
+        engine.on_fenced_orphan = \
+            lambda h: self.queue.requeue(h)
+        r.engine, r.queue = engine, queue
+        r.attempt = 0
+        r.dead = False
+        r.last_error = ""
+        r.stop = threading.Event()
+        r.state = RUNNING
+        self._event("serve_replica_up", replica=r.index,
+                    bringups=r.bringups, device=str(r.device))
+        if self._started:
+            self._spawn(r)
+        return True
+
+    # -- fencing and reclaim (failover / drain) -----------------------------
+
+    def _fence_and_reclaim(self, r: _Replica, now: float,
+                           reason: str) -> int:
+        """Fence the replica's engine, then reclaim every request it
+        held — private queue first (routed, never admitted), then the
+        in-slot handles — back into the shared queue at their original
+        arrival positions for deterministic replay. Fencing comes FIRST:
+        from that point the old engine cannot fulfil, complete, or
+        requeue anything, so the reclaim sweep is the single owner of
+        these handles (a wedge waking later hits the fence, and
+        ``fulfill`` being first-write-wins closes the last window)."""
+        eng, q = r.engine, r.queue
+        r.engine, r.queue, r.thread = None, None, None
+        if r.stop is not None:
+            r.stop.set()
+        reclaimed = 0
+        if eng is not None:
+            eng.fence()
+            # a crashed/exited loop left the lock free and the hang
+            # fault sleeps outside it, so this normally succeeds; a
+            # thread truly wedged INSIDE a step keeps the lock — the
+            # snapshot below is host-side bookkeeping only, safe to
+            # read anyway, and the fence already disarmed the wedge
+            got = eng._lock.acquire(timeout=0.2)
+            try:
+                queued = q.drain() if q is not None else []
+                slots = [s for s in list(eng.slots) if s is not None]
+                # inflight covers the slots AND any mid-admission
+                # handles a thread wedged inside the admission compile
+                # holds in step locals (engine._admitting)
+                inflight = eng.inflight_handles()
+            finally:
+                if got:
+                    eng._lock.release()
+            # fold the dead engine's counters into the set's base,
+            # un-crediting reclaimed requests' harvested prefixes: the
+            # replay re-credits every token, and the aggregate must
+            # keep counting DISTINCT delivered tokens (same discipline
+            # as paged eviction's un-credit)
+            retire = {k: getattr(eng, k, 0) for k in _COUNTERS}
+            for s in slots:
+                retire["tokens_decoded"] -= len(s.emitted)
+                retire["occupancy_sum"] -= len(s.emitted)
+            for k in _COUNTERS:
+                self._retired[k] += retire[k]
+            seen: set = set()
+            for h in queued + inflight:
+                rid = h.request.request_id
+                if h.done() or rid in seen:
+                    continue
+                seen.add(rid)
+                # original arrival position: zero-loss AND no
+                # queue-jumping — a replayed request neither loses
+                # its place nor steals anyone else's
+                self.queue.requeue(h)
+                reclaimed += 1
+        self.reclaimed += reclaimed
+        self._event("serve_replica_fenced", replica=r.index,
+                    reason=reason, reclaimed=reclaimed)
+        return reclaimed
+
+    def _failover(self, r: _Replica, now: float, reason: str) -> None:
+        self.failovers += 1
+        self._fence_and_reclaim(r, now, reason)
+        r.state = BROKEN
+        r.next_bringup_t = now          # first restart attempt is free;
+        #                                 backoff only after it fails
+
+    # -- operator drain -----------------------------------------------------
+
+    def drain_replica(self, index: int,
+                      reason: str = "operator drain") -> int:
+        """Planned maintenance: fence + reclaim (in-flight work replays
+        on the survivors, zero requests lost) and hold the replica DOWN
+        until ``undrain_replica``. Returns the number reclaimed."""
+        with self._ctl_lock:
+            r = self.replicas[index]
+            n = self._fence_and_reclaim(r, self.clock(), reason)
+            r.state = DRAINED
+            return n
+
+    def undrain_replica(self, index: int) -> bool:
+        """Bring a drained replica back into routing (one bring-up
+        attempt now; failure re-enters the circuit-breaker path)."""
+        with self._ctl_lock:
+            r = self.replicas[index]
+            if r.state != DRAINED:
+                return False
+            return self._bring_up(r, self.clock())
+
+    # -- supervision --------------------------------------------------------
+
+    def _check_replicas(self, now: float) -> bool:
+        """One supervision sweep: crashed loops and missed heartbeats
+        are fenced + reclaimed; circuit-broken replicas past their
+        backoff get a bring-up attempt. Hang detection applies only to
+        replicas with a live loop THREAD — in single-threaded drive the
+        driver itself is the loop, so a hang would block the driver,
+        and crashes surface synchronously in ``step_once``."""
+        did = False
+        for r in self.replicas:
+            if r.state == RUNNING:
+                if r.dead:
+                    self._failover(r, now,
+                                   reason=f"crash: {r.last_error}")
+                    did = True
+                elif r.thread is not None and not r.thread.is_alive():
+                    self._failover(r, now, reason="loop thread died")
+                    did = True
+                elif r.thread is not None and r.engine is not None \
+                        and not r.engine.compiling \
+                        and now - r.engine.last_heartbeat \
+                        > self.heartbeat_s:
+                    # ``compiling`` exempts a known first-call trace/
+                    # compile (seconds on a cold cache) from the hang
+                    # deadline — a healthy replica mid-compile must not
+                    # be fenced for being slow to warm up
+                    self._failover(
+                        r, now,
+                        reason=f"missed heartbeat "
+                               f"(> {self.heartbeat_s:g}s: hang)")
+                    did = True
+            elif r.state == BROKEN and now >= r.next_bringup_t:
+                did = self._bring_up(r, now) or did
+        return did
+
+    # -- routing ------------------------------------------------------------
+
+    def _expire(self, h: S.RequestHandle, now: float) -> None:
+        req = h.request
+        self.expired += 1
+        self._event("serve_deadline", request_id=req.request_id,
+                    where="queued", deadline_s=req.deadline_s,
+                    waited_s=round(now - req.submit_t, 4))
+        h.fulfill(S.Result(
+            status=S.DEADLINE_EXCEEDED, request_id=req.request_id,
+            reason=f"deadline_s={req.deadline_s:g} exceeded (queued)",
+            queued_s=round(now - req.submit_t, 6),
+            total_s=round(now - req.submit_t, 6)))
+
+    def _capacity(self, r: _Replica) -> int:
+        return max(0, r.engine.num_slots - r.engine.active_slots()
+                   - r.queue.depth())
+
+    def _pick(self, cands: List[_Replica], caps: dict,
+              h: S.RequestHandle) -> _Replica:
+        """Least-loaded with page-awareness: most free slot capacity
+        first; among paged replicas, one whose pool can map the
+        request's prompt span NOW beats one that would defer it, and
+        free pages break remaining ties."""
+        from dalle_pytorch_tpu.serve import kv_pool as KV
+
+        def score(r: _Replica):
+            eng = r.engine
+            fits, free_pages = True, 0
+            if eng.kv == "paged":
+                free_pages = eng.alloc.free
+                try:
+                    need = KV.pages_for(
+                        S.bucket_for(len(h.request.codes), eng.buckets),
+                        eng.page_size)
+                    fits = free_pages >= need
+                except ValueError:
+                    # an over-long prompt buckets nowhere; the engine's
+                    # admission turns it into a typed error result
+                    fits = True
+            return (fits, caps[r.index], free_pages, -r.index)
+
+        return max(cands, key=score)
+
+    def _route(self, now: float) -> bool:
+        """Move ready requests from the shared queue into per-replica
+        private queues (a hand-off: ``requeue(count=False)`` keeps the
+        handle's shared-queue identity and arrival position). Queued
+        deadline expiries are reaped here on EVERY sweep — even with
+        zero live replicas, a dead entry must get its typed result."""
+        live = [r for r in self.replicas
+                if r.state == RUNNING and r.engine is not None]
+        caps = {r.index: self._capacity(r) for r in live}
+        total = sum(caps.values())
+        ready, expired = self.queue.pop_ready(total, now)
+        for h in expired:
+            self._expire(h, now)
+        for h in ready:
+            cands = [r for r in live if caps[r.index] > 0]
+            r = self._pick(cands, caps, h)
+            caps[r.index] -= 1
+            r.queue.requeue(h, count=False)
+        return bool(ready or expired)
+
+    # -- the replica loop (threaded mode) -----------------------------------
+
+    def _spawn(self, r: _Replica) -> None:
+        r.thread = threading.Thread(
+            target=self._run_replica, args=(r, r.engine, r.stop),
+            daemon=True, name=f"serve-replica-{r.index}")
+        r.thread.start()
+
+    def _run_replica(self, r: _Replica, engine, stop) -> None:
+        """One replica's serving loop. A step exception is a CRASH —
+        recorded for the supervisor, loop exits (contrast the single-
+        engine ``Engine.run``, which fails the in-slot requests in
+        place: here the supervisor replays them instead, so the callers
+        get their exact tokens, not typed errors). A fence (failover
+        decided while this thread was wedged) ends the loop on the next
+        iteration."""
+        from dalle_pytorch_tpu.resilience import faults
+        while not stop.is_set() and not engine.fenced:
+            try:
+                faults.on_replica_chunk(
+                    r.index, engine.decode_steps // engine.chunk_steps)
+                busy = engine.step_once()
+            except Exception as e:  # noqa: BLE001 — supervised crash
+                if engine.fenced or r.engine is not engine:
+                    # a ZOMBIE crashing: this engine was already fenced
+                    # and replaced (e.g. a wedge that finally errored
+                    # out) — its requests were reclaimed long ago, and
+                    # flagging r.dead now would fail over the healthy
+                    # replacement that owns r
+                    return
+                r.last_error = repr(e)
+                r.dead = True
+                self._event("serve_replica_crash", replica=r.index,
+                            error=repr(e))
+                return
+            if not busy and engine.idle():
+                stop.wait(self._idle_sleep_s)
+
+    def _run_control(self, stop: threading.Event) -> None:
+        """Routing + supervision loop (threaded mode)."""
+        while not stop.is_set():
+            now = self.clock()
+            with self._ctl_lock:
+                busy = self._check_replicas(now)
+                busy = self._route(now) or busy
+            stop.wait(0.0005 if busy else self._idle_sleep_s)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ReplicaSet":
+        """Threaded mode: one loop thread per live replica plus the
+        control thread (routing + supervision)."""
+        self._started = True
+        if self._t_start is None:       # threaded mode never steps
+            self._t_start = self.clock()  # sync, so stamp elapsed here
+        for r in self.replicas:
+            if r.state == RUNNING and r.thread is None:
+                self._spawn(r)
+        self._ctl_stop = threading.Event()
+        self._ctl_thread = threading.Thread(
+            target=self._run_control, args=(self._ctl_stop,),
+            daemon=True, name="serve-replica-control")
+        self._ctl_thread.start()
+        return self
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop supervision, then every replica loop, joining each with
+        its share of the deadline. A replica that OUTLIVES its join
+        (wedged in a step) is fenced so it can never fulfil or requeue
+        later; either way its private queue is drained and every
+        still-open handle — queued or in-slot — is fulfilled
+        ``cancelled`` lock-free (first-write-wins makes the late-waker
+        race harmless). Callers are never stranded."""
+        t0 = time.perf_counter()
+        self._ctl_stop.set()
+        if self._ctl_thread is not None:
+            self._ctl_thread.join(timeout)
+        with self._ctl_lock:
+            for r in self.replicas:
+                if r.stop is not None:
+                    r.stop.set()
+            for r in self.replicas:
+                if r.thread is not None:
+                    left = max(0.1, timeout - (time.perf_counter() - t0))
+                    r.thread.join(left / max(len(self.replicas), 1))
+            for r in self.replicas:
+                eng, q = r.engine, r.queue
+                if r.thread is not None and r.thread.is_alive() \
+                        and eng is not None:
+                    eng.fence()
+                handles = []
+                if q is not None:
+                    handles.extend(q.drain())
+                if eng is not None:
+                    handles.extend(eng.inflight_handles())
+                for h in handles:
+                    if not h.done():
+                        h.fulfill(S.Result(
+                            status=S.CANCELLED,
+                            request_id=h.request.request_id,
+                            reason="server shutdown"))
+
+    # -- single-threaded drive (tests, bench) -------------------------------
+
+    def step_once(self) -> bool:
+        """One set iteration: supervise (bring-ups, crash cleanup),
+        route, then step every live replica once. Crashes fail over
+        INLINE — the same fence/reclaim/replay path the threaded
+        supervisor takes, just synchronously."""
+        from dalle_pytorch_tpu.resilience import faults
+        now = self.clock()
+        if self._t_start is None:
+            self._t_start = now
+        with self._ctl_lock:
+            did = self._check_replicas(now)
+            did = self._route(now) or did
+        for r in list(self.replicas):
+            if r.state != RUNNING or r.engine is None:
+                continue
+            eng = r.engine
+            try:
+                faults.on_replica_chunk(
+                    r.index, eng.decode_steps // eng.chunk_steps)
+                did = eng.step_once() or did
+            except Exception as e:  # noqa: BLE001 — supervised crash
+                r.last_error = repr(e)
+                self._event("serve_replica_crash", replica=r.index,
+                            error=repr(e))
+                with self._ctl_lock:
+                    self._failover(r, self.clock(),
+                                   reason=f"crash: {e!r}")
+                did = True
+        return did
+
+    def idle(self) -> bool:
+        if self.queue.depth() > 0:
+            return False
+        for r in self.replicas:
+            if r.queue is not None and r.queue.depth() > 0:
+                return False
+            if r.engine is not None and (r.engine.active_slots() > 0
+                                         or r.engine._pending):
+                return False
+        return True
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        for _ in range(max_steps):
+            busy = self.step_once()
+            if not busy and self.idle():
+                return
+        raise RuntimeError(
+            f"replica set did not go idle in {max_steps} steps")
+
+    # -- aggregate counters (bench._serve_load_point's surface) -------------
+
+    def _agg(self, name: str) -> int:
+        return self._retired[name] + sum(
+            getattr(r.engine, name, 0) for r in self.replicas
+            if r.engine is not None)
+
+    @property
+    def tokens_decoded(self) -> int:
+        return self._agg("tokens_decoded")
+
+    @property
+    def decode_steps(self) -> int:
+        return self._agg("decode_steps")
+
+    @property
+    def harvests(self) -> int:
+        return self._agg("harvests")
+
+    @property
+    def occupancy_sum(self) -> int:
+        return self._agg("occupancy_sum")
+
+    @property
+    def completed(self) -> int:
+        return self._agg("completed")
+
+    # -- observability ------------------------------------------------------
+
+    def alive(self) -> bool:
+        """True while at least one replica serves (healthz contract:
+        503 only when ALL are dead)."""
+        for r in self.replicas:
+            if r.state != RUNNING or r.engine is None:
+                continue
+            if r.thread is None or r.thread.is_alive():
+                return True
+        return False
+
+    def replica_states(self) -> List[dict]:
+        now = self.clock()
+        out = []
+        for r in self.replicas:
+            alive = r.state == RUNNING and r.engine is not None and \
+                (r.thread is None or r.thread.is_alive())
+            rec = {"replica": r.index, "state": r.state, "alive": alive,
+                   "bringups": r.bringups}
+            if r.engine is not None:
+                rec["heartbeat_age_s"] = round(
+                    max(now - r.engine.last_heartbeat, 0.0), 4)
+            if r.last_error:
+                rec["last_error"] = r.last_error
+            out.append(rec)
+        return out
+
+    def decode_compiles_per_replica(self) -> List[int]:
+        """Each LIVE replica's decode-program trace count — the
+        one-compile-per-replica contract bench_serve asserts (a
+        replaced engine is a fresh program, counted on its own)."""
+        return [r.engine.decode_traces for r in self.replicas
+                if r.engine is not None]
+
+    def stats(self) -> dict:
+        elapsed = None if self._t_start is None \
+            else max(self.clock() - self._t_start, 1e-9)
+        live = [r for r in self.replicas if r.engine is not None]
+        per = []
+        for r in self.replicas:
+            rec = {"replica": r.index, "state": r.state}
+            if r.engine is not None:
+                e = r.engine
+                rec.update({
+                    "active_slots": e.active_slots(),
+                    "queued": r.queue.depth() if r.queue else 0,
+                    "decode_compiles": e.decode_traces,
+                    "prefill_compiles": e.prefill_traces,
+                    "completed": e.completed,
+                    "tokens_decoded": e.tokens_decoded,
+                })
+                if e.kv == "paged":
+                    rec["pages_free"] = e.alloc.free
+            per.append(rec)
+        tokens = self.tokens_decoded
+        steps = self.decode_steps
+        return {
+            "replicas": self.n_replicas,
+            "alive_replicas": sum(
+                1 for r in self.replicas
+                if r.state == RUNNING and r.engine is not None),
+            "kv": self.kv,
+            "queue_depth": self.queue.depth() + sum(
+                r.queue.depth() for r in live if r.queue is not None),
+            "num_slots": sum(r.engine.num_slots for r in live),
+            "active_slots": sum(r.engine.active_slots() for r in live),
+            "chunk_steps": self._engine_kwargs["chunk_steps"],
+            "decode_steps": steps,
+            "tokens_decoded": tokens,
+            "tokens_per_s": (round(tokens / elapsed, 2)
+                             if elapsed else 0.0),
+            "mean_occupancy": round(self.occupancy_sum / max(steps, 1),
+                                    3),
+            "completed": self.completed,
+            "expired": self._agg("expired") + self.expired,
+            "rejected": self.queue.rejected,
+            "requeued": self.queue.requeued,
+            "decode_compiles": self._agg("decode_traces"),
+            "prefill_compiles": self._agg("prefill_traces"),
+            "harvests": self.harvests,
+            "host_round_trips_per_token": round(
+                self.harvests / max(tokens, 1), 6),
+            "failovers": self.failovers,
+            "reclaimed": self.reclaimed,
+            "bringup_failures": self.bringup_failures,
+            "evicted": self._agg("evicted"),
+            "per_replica": per,
+        }

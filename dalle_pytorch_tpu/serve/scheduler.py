@@ -168,12 +168,20 @@ class Result:
 class RequestHandle:
     """Future for one request: ``result(timeout)`` blocks until the
     engine/postprocess fulfils it. Always fulfilled with a ``Result`` —
-    including rejects and expiries — so callers never hang on overload."""
+    including rejects and expiries — so callers never hang on overload.
+
+    ``fulfill`` is FIRST-WRITE-WINS: replica failover re-queues a fenced
+    replica's in-flight requests for deterministic replay on a survivor,
+    so two engines can transiently both believe they own a handle (the
+    wedged one waking mid-step, and the replay). The first terminal
+    result sticks; a late second fulfil is a no-op, never an overwrite
+    of a result the caller may already have read."""
 
     def __init__(self, request: Request):
         self.request = request
         self._done = threading.Event()
         self._result: Optional[Result] = None
+        self._fulfill_lock = threading.Lock()
         # arrival order within the priority class, assigned at submit;
         # requeue (eviction/page-defer) re-inserts with the SAME seq so
         # a request never loses its place in line — without this, a
@@ -184,9 +192,13 @@ class RequestHandle:
     def done(self) -> bool:
         return self._done.is_set()
 
-    def fulfill(self, result: Result) -> None:
-        self._result = result
-        self._done.set()
+    def fulfill(self, result: Result) -> bool:
+        with self._fulfill_lock:
+            if self._done.is_set():
+                return False
+            self._result = result
+            self._done.set()
+            return True
 
     def result(self, timeout: Optional[float] = None) -> Result:
         if not self._done.wait(timeout):
@@ -273,23 +285,29 @@ class RequestQueue:
                            (request.priority, handle.queue_seq, handle))
             return handle
 
-    def requeue(self, handle: RequestHandle) -> None:
+    def requeue(self, handle: RequestHandle, count: bool = True) -> None:
         """Push an already-admitted request BACK into the queue — the
-        paged engine's eviction/page-backpressure path (a victim's pages
-        are freed and the request re-enters the line, never dropped).
-        The handle and its original ``submit_t`` are preserved, so the
-        caller's future stays live and latency accounting covers both
-        attempts. Deliberately not subject to ``max_depth`` (the request
-        already passed admission once; shedding it here would turn
-        backpressure into a silent drop) nor to ``close()`` gating. It
-        re-enters at its ORIGINAL arrival position (``queue_seq``), not
-        the back of its priority class: together with the engine's
-        head-of-line page reservation this is what makes 'no request
-        starves forever' true — later-arriving requests can never leap-
-        frog a page-deferred one indefinitely. A requeue landing AFTER
-        the shutdown drain fulfils the handle as ``cancelled`` on the
-        spot: the heap is dead by then, nobody would ever pop it, and
-        leaving it there would strand the caller in ``result()``."""
+        paged engine's eviction/page-backpressure path and replica
+        failover's reclaim path (a victim's pages are freed, or its dead
+        replica fenced, and the request re-enters the line, never
+        dropped). The handle and its original ``submit_t`` are
+        preserved, so the caller's future stays live and latency
+        accounting covers both attempts. Deliberately not subject to
+        ``max_depth`` (the request already passed admission once;
+        shedding it here would turn backpressure into a silent drop)
+        nor to ``close()`` gating. It re-enters at its ORIGINAL arrival
+        position (``queue_seq``), not the back of its priority class:
+        together with the engine's head-of-line page reservation this
+        is what makes 'no request starves forever' true — later-
+        arriving requests can never leapfrog a page-deferred one
+        indefinitely. A requeue landing AFTER the shutdown drain
+        fulfils the handle as ``cancelled`` on the spot: the heap is
+        dead by then, nobody would ever pop it, and leaving it there
+        would strand the caller in ``result()``.
+
+        ``count=False`` is the replica-set router's hand-off into a
+        replica's private queue — a normal dispatch, not backpressure —
+        so ``requeued`` keeps meaning evictions/deferrals/failovers."""
         with self._lock:
             if self._drained:
                 handle.fulfill(Result(
@@ -297,7 +315,14 @@ class RequestQueue:
                     request_id=handle.request.request_id,
                     reason="server shutdown"))
                 return
-            self.requeued += 1
+            if any(entry[2] is handle for entry in self._heap):
+                # already back in line: the failover reclaim sweep and a
+                # fenced engine waking from a wedge can both try to
+                # return the same handle — a double entry would admit
+                # (and decode) the request twice
+                return
+            if count:
+                self.requeued += 1
             heapq.heappush(self._heap, (handle.request.priority,
                                         handle.queue_seq, handle))
 

@@ -41,7 +41,13 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
 
 
 class InferenceServer:
-    """Continuous-batching text->image service over one model replica."""
+    """Continuous-batching text->image service. ``replicas=1`` (the
+    default) runs one engine on one thread; ``replicas=N`` fronts N
+    supervised engine replicas with the same shared queue through
+    ``serve.replica.ReplicaSet`` — replica crash/hang/drain fails over
+    with zero lost requests via deterministic replay, and capacity loss
+    degrades to typed ``QueueFull`` backpressure (docs/SERVING.md
+    'Replica set & failover')."""
 
     def __init__(self, params: dict, vae_params: dict, cfg, *,
                  num_slots: int = 4, queue_depth: int = 64,
@@ -51,6 +57,8 @@ class InferenceServer:
                  kv: str = "dense",
                  page_size: int = 0,
                  num_pages: int = 0,
+                 replicas: int = 1,
+                 heartbeat_s: float = 5.0,
                  clip_params: Optional[dict] = None, clip_cfg=None,
                  decode_images: bool = True,
                  metrics=None, log_every: int = 50,
@@ -61,6 +69,7 @@ class InferenceServer:
         self.encode = encode
         self.init_deadline_s = init_deadline_s
         self.init_retries = init_retries
+        self.replicas = int(replicas)
 
         self.queue = S.RequestQueue(
             max_depth=queue_depth,
@@ -75,12 +84,23 @@ class InferenceServer:
                 params, vae_params, cfg, clip_params=clip_params,
                 clip_cfg=clip_cfg, metrics=metrics,
                 on_fulfill=self._record_latency)
-        self.engine = engine_mod.Engine(
-            params, cfg, self.queue, num_slots=num_slots,
-            chunk_steps=chunk_steps, prefill_buckets=prefill_buckets,
-            complete=self._on_decoded, metrics=metrics,
-            log_every=log_every, quantize_cache=quantize_cache,
-            kv=kv, page_size=page_size, num_pages=num_pages)
+        if self.replicas > 1:
+            from dalle_pytorch_tpu.serve import replica as replica_mod
+            self.engine = replica_mod.ReplicaSet(
+                params, cfg, self.queue, replicas=self.replicas,
+                num_slots=num_slots, chunk_steps=chunk_steps,
+                prefill_buckets=prefill_buckets,
+                complete=self._on_decoded, metrics=metrics,
+                log_every=log_every, quantize_cache=quantize_cache,
+                kv=kv, page_size=page_size, num_pages=num_pages,
+                heartbeat_s=heartbeat_s)
+        else:
+            self.engine = engine_mod.Engine(
+                params, cfg, self.queue, num_slots=num_slots,
+                chunk_steps=chunk_steps, prefill_buckets=prefill_buckets,
+                complete=self._on_decoded, metrics=metrics,
+                log_every=log_every, quantize_cache=quantize_cache,
+                kv=kv, page_size=page_size, num_pages=num_pages)
 
         # bounded window: p50/p95 over the last 10k completions — an
         # unbounded list would grow (and re-sort under the lock) forever
@@ -139,22 +159,33 @@ class InferenceServer:
 
         if self.post is not None:
             self.post.start()
-        self._thread = threading.Thread(
-            target=self.engine.run, args=(self._stop,), daemon=True,
-            name="serve-engine")
-        self._thread.start()
+        if self.replicas > 1:
+            self.engine.start()     # per-replica threads + supervisor
+        else:
+            self._thread = threading.Thread(
+                target=self.engine.run, args=(self._stop,), daemon=True,
+                name="serve-engine")
+            self._thread.start()
         return self
 
     def close(self, timeout: float = 30.0) -> None:
         """Close the queue (a submit racing shutdown gets a typed
         ``QueueClosed`` instead of landing after the drain and hanging
-        its caller), stop the engine, then cancel everything still
-        queued AND everything mid-decode in a slot (typed results — the
-        no-hangs contract holds through shutdown for admitted requests
-        too), then drain the postprocess stage."""
+        its caller), stop the engine(s) — the replica path joins EVERY
+        replica thread with its share of the deadline, and a replica
+        outliving its join is fenced so it cannot fulfil or requeue
+        later — then drain the shared queue ONCE and cancel everything
+        still queued AND everything mid-decode in a slot (typed results
+        — the no-hangs contract holds through shutdown for admitted
+        requests too), then drain the postprocess stage. The drain runs
+        AFTER the engines stop, so a straggler's late requeue lands on
+        the drained queue and is fulfilled ``cancelled`` on the spot
+        instead of stranding its caller."""
         self.queue.close()
         self._stop.set()
-        if self._thread is not None:
+        if self.replicas > 1:
+            self.engine.close(timeout)
+        elif self._thread is not None:
             self._thread.join(timeout)
         for handle in self.queue.drain():
             handle.fulfill(S.Result(
@@ -163,7 +194,9 @@ class InferenceServer:
                 reason="server shutdown"))
         # after the engine thread stopped: slots still holding requests
         # would otherwise leave their callers blocked in result()
-        self.engine.cancel_active("server shutdown")
+        # (the replica path cancelled its in-slot handles in close())
+        if self.replicas == 1:
+            self.engine.cancel_active("server shutdown")
         if self.post is not None:
             self.post.close(timeout)
 
@@ -190,8 +223,22 @@ class InferenceServer:
         return self.submit(codes, **kwargs).result(timeout)
 
     def engine_alive(self) -> bool:
-        """True while the engine thread is serving (or before start)."""
+        """True while the serving loop is live (or before start). For a
+        replica set: at least ONE replica serving — the set degrades,
+        it does not die with a survivor standing."""
+        if self.replicas > 1:
+            return self.engine.alive()
         return self._thread is None or self._thread.is_alive()
+
+    def health(self) -> dict:
+        """The /healthz body: overall liveness plus, for a replica set,
+        per-replica state (``running``/``broken``/``drained``,
+        heartbeat age) — ``ok`` is False (HTTP 503) only when EVERY
+        replica is dead."""
+        out = {"ok": self.engine_alive()}
+        if self.replicas > 1:
+            out["replicas"] = self.engine.replica_states()
+        return out
 
     def stats(self) -> dict:
         with self._lat_lock:
@@ -252,10 +299,11 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
 
         def do_GET(self):
             if self.path == "/healthz":
-                # health must reflect the serving loop, not just this
-                # HTTP thread — a dead engine thread is a dead service
-                alive = server.engine_alive()
-                self._send(200 if alive else 503, {"ok": alive})
+                # health must reflect the serving loop(s), not just
+                # this HTTP thread — and for a replica set, per-replica
+                # liveness with 503 only when ALL replicas are dead
+                body = server.health()
+                self._send(200 if body["ok"] else 503, body)
             elif self.path == "/stats":
                 self._send(200, server.stats())
             else:

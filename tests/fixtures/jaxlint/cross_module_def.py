@@ -1,0 +1,29 @@
+"""Cross-module corpus, defining half (pairs with cross_module_use.py;
+driven by tests/test_analysis.py::TestCrossModule, NOT by the solo
+per-file fixture loop — every finding here needs project mode).
+
+Exports a MODULE-LEVEL jitted program (``fused_step``) and a plain
+helper whose body holds a host sync. Solo, this file is clean: nothing
+in it jits ``helper_with_sync``. Project mode must flag the sync once
+cross_module_use.py wraps the helper in ``jax.jit`` — traced
+reachability across the file boundary, the shape the serve replica
+layer takes when it drives jitted engine internals from another module.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _step_math(x):
+    return jnp.tanh(x) * 2.0
+
+
+def helper_with_sync(x):
+    # flagged (JL001) ONLY when the sibling module jits this function —
+    # the marker below is asserted by the project-mode test, and its
+    # ABSENCE by the solo-mode test
+    return np.asarray(x) + 1          # cross-expect: JL001
+
+
+fused_step = jax.jit(_step_math)

@@ -1,0 +1,35 @@
+"""Cross-module corpus, using half (pairs with cross_module_def.py).
+
+Imports the sibling's module-level jitted program. Solo, this file is
+clean — nothing HERE is assigned from a jit expression, so the per-file
+pass has no idea ``fused_step`` is a jitted callable. Project mode must
+flag the host round-trip on its output (JL001) and the eager
+``lax.cond`` dispatched on it (JL009), and must mark the imported
+``helper_with_sync`` as traced over in the defining module.
+"""
+
+import jax
+import numpy as np
+from jax import lax
+
+from cross_module_def import fused_step, helper_with_sync
+
+
+def drive(x):
+    out = fused_step(x)
+    return np.asarray(out)            # cross-expect: JL001
+
+
+def eager_control(x):
+    out = fused_step(x)
+    return lax.cond(out[0] > 0,       # cross-expect: JL009
+                    lambda: 1, lambda: 0)
+
+
+def rebound_is_clean(x):
+    out = fused_step(x)
+    out = np.zeros(3)                 # rebound to host data: no finding
+    return np.asarray(out)
+
+
+jitted_helper = jax.jit(helper_with_sync)

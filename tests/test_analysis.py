@@ -146,18 +146,61 @@ class TestCLI:
         assert proc.returncode == 1, proc.stderr
 
 
+class TestCrossModule:
+    """Project mode (``jaxlint.lint_files`` — what the CLI and the
+    repo-clean test run): JL001/JL009 traced reachability across module
+    boundaries. The fixture pair proves both directions — a host sync
+    on an IMPORTED module-level jitted program's output, and a host
+    sync inside a function that only becomes traced because the SIBLING
+    module jits it — and that per-file mode stays blind to both (the
+    propagation, not a rule change, is what fires them)."""
+
+    PAIR = [FIXTURES / "cross_module_def.py",
+            FIXTURES / "cross_module_use.py"]
+    _CROSS_RE = re.compile(r"#\s*cross-expect:\s*(JL\d{3})")
+
+    def _expected(self):
+        out = set()
+        for p in self.PAIR:
+            for i, line in enumerate(p.read_text().splitlines(),
+                                     start=1):
+                m = self._CROSS_RE.search(line)
+                if m:
+                    out.add((p.name, i, m.group(1)))
+        return out
+
+    def test_solo_mode_is_blind_to_the_pair(self):
+        """Each half lints CLEAN alone — the findings exist only in the
+        cross-module view, so this pair must stay out of the solo
+        fixture corpus loop."""
+        for p in self.PAIR:
+            assert jaxlint.lint_file(p) == [], p.name
+
+    def test_project_mode_exact_agreement(self):
+        expected = self._expected()
+        assert expected, "pair has no # cross-expect markers"
+        assert {"JL001", "JL009"} <= {r for _, _, r in expected}
+        actual = {(Path(f.path).name, f.line, f.rule)
+                  for f in jaxlint.lint_files(self.PAIR)}
+        missed = expected - actual
+        spurious = actual - expected
+        assert not missed, f"cross-module propagation went quiet: " \
+                           f"{sorted(missed)}"
+        assert not spurious, f"flagged legal cross-module idiom: " \
+                             f"{sorted(spurious)}"
+
+
 class TestRepoIsClean:
     def test_package_and_tests_lint_clean(self):
         """The merged-tree acceptance criterion, as a tier-1 test: every
-        finding in the package, tests, scripts, and bench is fixed or
-        carries an in-line waiver."""
+        finding in the package, tests, scripts, and bench — INCLUDING
+        project-mode cross-module propagation — is fixed or carries an
+        in-line waiver."""
         root = Path(__file__).parents[1]
         files = jaxlint.iter_py_files(
             [str(root / "dalle_pytorch_tpu"), str(root / "tests"),
              str(root / "scripts"), str(root / "bench.py")])
-        findings = []
-        for f in files:
-            findings.extend(jaxlint.lint_file(f))
+        findings = jaxlint.lint_files(files)
         assert findings == [], "\n".join(x.render() for x in findings)
 
 
