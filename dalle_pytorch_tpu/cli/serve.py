@@ -91,6 +91,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "survivor with bit-identical tokens — zero "
                         "requests lost (docs/SERVING.md 'Replica set & "
                         "failover')")
+    p.add_argument("--isolation", choices=("thread", "process"),
+                   default="thread",
+                   help="replica isolation (replicas > 1): 'thread' = "
+                        "replicas share this process (cheapest); "
+                        "'process' = each replica's engine in a "
+                        "spawned child process with its own jax "
+                        "client, so a segfault, host OOM kill, or "
+                        "kill -9 of one replica costs latency on the "
+                        "requests it held — replayed token-exact on a "
+                        "survivor — never the server (docs/SERVING.md "
+                        "'Process isolation')")
+    p.add_argument("--child_rss_limit_mb", type=int, default=0,
+                   help="process isolation: a child worker whose RSS "
+                        "crosses this dies with exit 137 (the "
+                        "container OOM-kill convention) and is fenced "
+                        "+ replayed like any other child death; 0 = "
+                        "no limit")
     p.add_argument("--heartbeat_s", type=float, default=5.0,
                    help="replica hang detection: a replica whose "
                         "serving loop misses heartbeats for this long "
@@ -178,13 +195,16 @@ def main(argv=None):
         quantize_cache=args.quantize == "int8_kv",
         kv=args.kv, page_size=args.page_size, num_pages=args.num_pages,
         replicas=args.replicas, heartbeat_s=args.heartbeat_s,
+        isolation=args.isolation,
+        child_rss_limit_mb=args.child_rss_limit_mb,
         clip_params=clip_params, clip_cfg=clip_cfg, metrics=metrics,
         log_every=args.log_every, encode=vocab.encode,
         init_deadline_s=args.init_deadline_s,
         init_retries=args.init_retries).start()
     say(f"serving {dalle_path} on http://{args.host}:{args.port} "
-        f"({args.replicas} replica(s) x {args.num_slots} slots, "
-        f"K={args.chunk_steps}, kv={args.kv}, queue {args.queue_depth})")
+        f"({args.replicas} {args.isolation} replica(s) x "
+        f"{args.num_slots} slots, K={args.chunk_steps}, kv={args.kv}, "
+        f"queue {args.queue_depth})")
     serve_http(server, args.host, args.port)
 
 

@@ -17,6 +17,16 @@ Simulated faults (pytest -m faults exercises each):
                                           / simulate_interrupted_save
   * serving replica crash / hang       -> on_replica_chunk
   * flaky replica bring-up             -> on_replica_bringup
+  * HARD replica kills (process mode)  -> on_worker_chunk
+      real SIGKILL / SIGSEGV via os.kill on the child worker itself,
+      memory exhaustion against the worker's RSS watchdog (exit 137,
+      the container OOM-kill convention), and a corrupt IPC frame the
+      parent must fence on — these need ``--isolation process`` (a
+      thread cannot survive its own injected SIGKILL). Hard-fault
+      plans cross the process boundary through ``child_plan_for``
+      exactly once per activation per replica, so a restarted child
+      never re-fires its own kill (fire-once is kept parent-side: the
+      child's ``_fired`` set dies with it).
 """
 
 from __future__ import annotations
@@ -62,6 +72,21 @@ class FaultPlan:
     replica_hang_at_chunk: int = -1
     replica_hang_s: float = 30.0
     replica_flaky_bringup: int = 0
+    # HARD serve faults (process-isolated replicas, serve/worker.py):
+    # the child worker kills ITSELF with a real signal once it has
+    # dispatched this many fused chunks — SIGKILL (what a host OOM
+    # killer or an operator `kill -9` delivers) or SIGSEGV (what an XLA
+    # bug delivers); replica_oom_at_chunk allocates real memory until
+    # the worker's RSS watchdog trips (the child dies with exit 137,
+    # the container OOM-kill convention — requires the replica set's
+    # child_rss_limit_mb); replica_garbage_frame_at_chunk makes the
+    # child emit one corrupt IPC frame (the parent must fence on the
+    # protocol error, never deadlock). All -1 = off, fire at most once,
+    # and target fault_replica only.
+    replica_sigkill_at_chunk: int = -1
+    replica_segv_at_chunk: int = -1
+    replica_oom_at_chunk: int = -1
+    replica_garbage_frame_at_chunk: int = -1
 
 
 _active: Optional[FaultPlan] = None
@@ -205,6 +230,87 @@ def on_replica_chunk(replica: int, chunk: int) -> None:
             and chunk >= p.replica_hang_at_chunk \
             and _once("replica_hang"):
         time.sleep(p.replica_hang_s)
+
+
+def child_plan_for(replica: int) -> Optional[dict]:
+    """The active plan's dict form for ``replica``'s CHILD process spawn
+    (serve/replica.py passes it into the worker spec; the child
+    activates it instead of reading ``DALLE_FAULTS`` itself). Returns a
+    plan AT MOST ONCE per activation per replica: the hard faults kill
+    the child for real, and a restarted child re-activating the same
+    plan would re-fire its own kill forever — fire-once must live in
+    the parent, the only process that survives the fault."""
+    p = _active
+    if p is None or replica != p.fault_replica:
+        return None
+    if not _once(f"child_plan_{replica}"):
+        return None
+    return dataclasses.asdict(p)
+
+
+# module-level on purpose: the injected-OOM allocations must stay
+# referenced until the worker's RSS watchdog (or the kernel) kills the
+# process — a local would be freed on return and the RSS would fall
+# back under the limit before the check runs
+_oom_ballast: list = []
+
+
+def on_worker_chunk(replica: int, chunk: int, *,
+                    emit_frame=None,
+                    rss_limit_mb: int = 0,
+                    rss_mb=None) -> None:
+    """Inside a child-process worker's loop (serve/worker.py), before
+    each engine step — the HARD half of the serve fault catalog, which
+    only a process can survive being injected with:
+
+      * ``replica_sigkill_at_chunk`` / ``replica_segv_at_chunk``: a
+        real ``os.kill`` on the worker itself — no Python cleanup, no
+        goodbye frame; the parent must detect the death from PID
+        liveness + exit-signal decoding and replay from its own shadow
+        bookkeeping;
+      * ``replica_oom_at_chunk``: allocate-and-touch real memory in
+        64 MiB steps until the worker's RSS (``rss_mb()``) crosses
+        ``rss_limit_mb`` — the worker's own watchdog then dies with
+        exit 137, exactly the kill a container memory limit delivers;
+      * ``replica_garbage_frame_at_chunk``: ship one corrupt frame
+        through ``emit_frame`` — the parent must fence this replica on
+        the protocol error rather than deadlock on it.
+
+    Like the soft hooks: no-op without an active plan, targets
+    ``fault_replica`` only, each fault fires at most once."""
+    p = _active
+    if p is None or replica != p.fault_replica:
+        return
+    if p.replica_sigkill_at_chunk >= 0 \
+            and chunk >= p.replica_sigkill_at_chunk \
+            and _once("worker_sigkill"):
+        os.kill(os.getpid(), signal.SIGKILL)
+    if p.replica_segv_at_chunk >= 0 \
+            and chunk >= p.replica_segv_at_chunk \
+            and _once("worker_segv"):
+        os.kill(os.getpid(), signal.SIGSEGV)
+    if p.replica_oom_at_chunk >= 0 \
+            and chunk >= p.replica_oom_at_chunk \
+            and _once("worker_oom"):
+        if not rss_limit_mb or rss_mb is None:
+            raise FaultInjected(
+                "replica_oom_at_chunk fired but the worker has no RSS "
+                "limit to exhaust — run the replica set with "
+                "child_rss_limit_mb set, or this fault proves nothing")
+        import numpy as np
+        for _ in range(256):            # hard cap: never OOM the host
+            if rss_mb() > rss_limit_mb:
+                return                  # watchdog kills on next check
+            _oom_ballast.append(np.ones((64, 1024, 1024), np.uint8))
+        raise FaultInjected(
+            f"allocated {len(_oom_ballast) * 64} MiB without crossing "
+            f"rss_limit_mb={rss_limit_mb} — limit too high to exercise")
+    if p.replica_garbage_frame_at_chunk >= 0 \
+            and chunk >= p.replica_garbage_frame_at_chunk \
+            and emit_frame is not None and _once("worker_garbage"):
+        # emit_frame checked BEFORE consuming the fire-once token: a
+        # call without an emitter must not silently burn the fault
+        emit_frame(b"\xde\xad\xbe\xef not a frame")
 
 
 def on_replica_bringup(replica: int, attempt: int) -> None:

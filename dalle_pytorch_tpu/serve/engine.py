@@ -86,6 +86,14 @@ import numpy as np
 
 from dalle_pytorch_tpu.serve import scheduler as S
 
+# the engine's lifetime counters, as one tuple so every aggregation
+# site — the replica set's retired-counter fold, the IPC heartbeat
+# snapshot a child worker ships, the parent-side client's mirror —
+# reads the SAME set and cannot drift from stats()
+COUNTERS = ("tokens_decoded", "decode_steps", "harvests",
+            "occupancy_sum", "completed", "expired",
+            "decode_traces", "prefill_traces", "evicted")
+
 
 class _Slot:
     """Host-side bookkeeping for one slot of the pool. Decode state
@@ -478,6 +486,43 @@ class Engine:
                 seen.add(rid)
                 out.append(h)
         return out
+
+    def progress_snapshot(self) -> Dict[int, int]:
+        """``{request_id: tokens_emitted_so_far}`` for every in-slot
+        request — pure host bookkeeping, no device sync. This is the
+        supervision surface that works WITHOUT a shared heap: a child-
+        process worker ships it in every heartbeat/harvest frame, and
+        the parent's retire math subtracts exactly these prefixes for
+        the requests it reclaims (replay re-credits every token, so the
+        aggregate keeps counting distinct delivered tokens even though
+        parent and child never share memory)."""
+        return {s.handle.request.request_id: len(s.emitted)
+                for s in list(self.slots) if s is not None}
+
+    def counters(self) -> Dict[str, int]:
+        """The ``COUNTERS`` block as a dict (heartbeat/retire surface)."""
+        return {k: int(getattr(self, k, 0)) for k in COUNTERS}
+
+    def compile_pending(self) -> bool:
+        """True when the NEXT ``step_once`` may block in a trace/compile
+        (cold decode program, or a queued prompt whose bucket has no
+        compiled prefill yet). A child-process worker cannot stamp a
+        heartbeat MID-step the way the in-process loop flips
+        ``self.compiling``, so it asks this before stepping and sends a
+        compiling=True heartbeat first — otherwise the supervisor would
+        read the compile-length silence as a hang and hard-kill a
+        healthy child warming up."""
+        if self.decode_traces == 0 and (self.active_slots() > 0
+                                        or self.queue.depth() > 0):
+            return True
+        for n in self.queue.pending_prompt_lens():
+            try:
+                b = S.bucket_for(n, self.buckets)
+            except ValueError:
+                continue            # admission rejects it, no compile
+            if b not in self._prefill_fns:
+                return True
+        return False
 
     def _orphan_handles(self, handles) -> None:
         """Hand fenced-mid-step handles back to the supervisor (they

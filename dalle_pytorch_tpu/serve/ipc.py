@@ -1,0 +1,470 @@
+"""Typed IPC for process-isolated replica serving.
+
+``serve/replica.py``'s fence/reclaim/replay protocol was built process-
+shape-agnostic; this module is the process shape. One replica = one
+child process (``serve/worker.py``) running its own Python interpreter,
+its own jax client, its own ``Engine`` — so a segfault in XLA, a host
+OOM kill, or an operator ``kill -9`` takes down ONE replica, not the
+set. Parent and child share nothing but a duplex pipe carrying framed,
+versioned, checksummed messages:
+
+  parent -> child:  ADMIT (request batches), FENCE, SHUTDOWN, STATS_REQ
+  child -> parent:  READY, HEARTBEAT, HARVEST (completed-result batches
+                    + the engine-state snapshot), STATS, CRASH, BYE
+
+Design rules, each load-bearing for the zero-loss contract:
+
+  * **The parent never trusts the child.** Every handle routed to a
+    child stays in the parent-side *shadow* (``ChildEngineClient
+    .shadow``) until its result frame lands. Reclaim-on-death reads the
+    shadow, never asks the corpse — a SIGKILLed child answers nothing.
+  * **Counters ride the frames that explain them.** A harvest frame
+    carries the child's lifetime counters and per-request progress AS
+    OF that frame, and completions are never counted ahead of the
+    frame that ships their result. Whatever prefix of frames the
+    parent managed to read before the child died is therefore a
+    CONSISTENT state: salvaged results fulfil their handles, everything
+    still open is reclaimed, and the retire math (counters minus
+    reclaimed requests' progress) keeps the set's aggregates counting
+    distinct delivered tokens — exactly through a `kill -9`.
+  * **Corruption fences, never hangs.** Every frame is
+    magic+version+kind+CRC32-checked before its payload is parsed; a
+    truncated or garbage frame raises a typed ``IPCError``, the client
+    marks itself poisoned, and the supervisor fences the replica (kill
+    + reclaim + replay) — the one safe response to a peer whose stream
+    can no longer be believed.
+  * **Two clocks never cross the pipe raw.** Deadlines ship as
+    remaining budget; latency is restamped against the parent's clock
+    at fulfilment. The only cross-process timestamps are the snapshot
+    stamps used for the IPC-lag metric, taken from ``perf_counter`` —
+    CLOCK_MONOTONIC on Linux, one epoch machine-wide.
+
+The client is SINGLE-OWNER by design: only the replica set's control
+thread (threaded mode) or the sync driver (tests/bench) may touch
+``route``/``pump``/``fence``/``reclaim`` — the same no-reentrancy
+discipline as ``Engine.step_once``, which is what lets the whole
+protocol run lock-free in the parent.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import signal
+import struct
+import time
+import zlib
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from dalle_pytorch_tpu.serve import scheduler as S
+from dalle_pytorch_tpu.serve.engine import COUNTERS
+
+PROTOCOL_VERSION = 1
+
+# frame kinds — parent -> child
+ADMIT = "admit"
+FENCE = "fence"
+SHUTDOWN = "shutdown"
+STATS_REQ = "stats_req"
+# frame kinds — child -> parent
+READY = "ready"
+HEARTBEAT = "heartbeat"
+HARVEST = "harvest"
+STATS = "stats"
+CRASH = "crash"
+BYE = "bye"
+
+KINDS = (ADMIT, FENCE, SHUTDOWN, STATS_REQ,
+         READY, HEARTBEAT, HARVEST, STATS, CRASH, BYE)
+_KIND_ID = {k: i for i, k in enumerate(KINDS)}
+
+_MAGIC = 0xD5
+# magic, version, kind, pad, crc32(payload)
+_HEADER = struct.Struct("<BBBxI")
+
+# results per harvest frame: keeps every frame comfortably under the
+# pipe's atomic-write buffer (a frame torn across writes by a kill
+# mid-send must be the rare case the CRC catches, not the common one)
+HARVEST_BATCH = 8
+
+# exit code the worker dies with when its RSS watchdog trips — the
+# 128+SIGKILL convention container runtimes use for memory kills, so
+# operators read it the same way in either environment
+OOM_EXIT = 137
+
+
+class IPCError(RuntimeError):
+    """A frame that cannot be believed: truncated, wrong magic, version
+    skew, checksum mismatch, unparseable payload, or fields of the
+    wrong shape. The only safe response is to FENCE the peer — a
+    stream that produced one lie may have corrupted anything."""
+
+
+def encode_frame(kind: str, payload: dict) -> bytes:
+    body = json.dumps(payload, separators=(",", ":")).encode()
+    return _HEADER.pack(_MAGIC, PROTOCOL_VERSION, _KIND_ID[kind],
+                        zlib.crc32(body)) + body
+
+
+def decode_frame(data: bytes):
+    """-> (kind, payload). Raises ``IPCError`` on anything untrustworthy."""
+    if len(data) < _HEADER.size:
+        raise IPCError(f"truncated frame: {len(data)} bytes < "
+                       f"{_HEADER.size}-byte header")
+    magic, version, kind_id, crc = _HEADER.unpack_from(data)
+    if magic != _MAGIC:
+        raise IPCError(f"bad magic 0x{magic:02x}")
+    if version != PROTOCOL_VERSION:
+        raise IPCError(f"protocol version skew: peer speaks v{version}, "
+                       f"this process v{PROTOCOL_VERSION}")
+    if kind_id >= len(KINDS):
+        raise IPCError(f"unknown frame kind id {kind_id}")
+    body = data[_HEADER.size:]
+    if zlib.crc32(body) != crc:
+        raise IPCError("payload checksum mismatch (corrupt or torn frame)")
+    try:
+        payload = json.loads(body.decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise IPCError(f"unparseable payload: {e}") from None
+    if not isinstance(payload, dict):
+        raise IPCError(f"payload must be an object, got "
+                       f"{type(payload).__name__}")
+    return KINDS[kind_id], payload
+
+
+def engine_snapshot(engine, chunks: int, rss_mb: int,
+                    compiling: bool) -> dict:
+    """The child's engine state as one wire dict — counters, per-request
+    progress, occupancy and kv facts — built by the worker and absorbed
+    by ``ChildEngineClient``. Progress keys are stringified (JSON
+    objects key on strings); the client converts them back."""
+    snap = {
+        "counters": engine.counters(),
+        "progress": {str(k): int(v)
+                     for k, v in engine.progress_snapshot().items()},
+        "active_slots": int(engine.active_slots()),
+        "queued": int(engine.queue.depth()),
+        "chunks": int(chunks),
+        "compiling": bool(compiling),
+        "rss_mb": int(rss_mb),
+        "t": time.perf_counter(),
+        "pages_free": (int(engine.alloc.free)
+                       if engine.kv == "paged" else -1),
+    }
+    return snap
+
+
+def _snap_fields(payload: dict):
+    """Validate + convert a snapshot payload; IPCError on wrong shapes."""
+    try:
+        counters = {k: int(payload["counters"][k]) for k in COUNTERS}
+        progress = {int(k): int(v)
+                    for k, v in payload["progress"].items()}
+        return (counters, progress, int(payload["active_slots"]),
+                int(payload["queued"]), int(payload["chunks"]),
+                bool(payload["compiling"]), int(payload["rss_mb"]),
+                float(payload["t"]), int(payload["pages_free"]))
+    except (KeyError, TypeError, ValueError) as e:
+        raise IPCError(f"malformed snapshot: {e!r}") from None
+
+
+class ChildEngineClient:
+    """Parent-side endpoint for one child-process engine replica.
+
+    Quacks enough like ``Engine`` for the replica set's supervisor,
+    router, and stats aggregation to stay mode-agnostic: the
+    ``COUNTERS`` show through as attributes (mirrored from the last
+    frame), plus ``num_slots`` / ``kv`` / ``active_slots()`` /
+    ``last_heartbeat`` / ``compiling`` / ``fenced`` /
+    ``inflight_handles()``. What it adds is the process half: PID
+    liveness, exit decoding, the shadow bookkeeping, and hard-kill."""
+
+    def __init__(self, params, cfg, *, index: int,
+                 engine_kwargs: dict,
+                 device_index: int = 0,
+                 place: bool = False,
+                 heartbeat_interval_s: float = 0.05,
+                 rss_limit_mb: int = 0,
+                 fault_plan: Optional[dict] = None,
+                 idle_sleep_s: float = 0.002,
+                 clock: Callable[[], float] = time.perf_counter,
+                 on_done: Optional[Callable] = None):
+        from dalle_pytorch_tpu.serve import worker as worker_mod
+
+        self.clock = clock
+        self.index = int(index)
+        self.num_slots = int(engine_kwargs.get("num_slots", 4))
+        self.chunk_steps = int(engine_kwargs.get("chunk_steps", 8))
+        self.kv = str(engine_kwargs.get("kv", "dense"))
+        self.on_done = on_done
+        spec = {
+            "index": self.index,
+            "params": params,              # numpy pytree (picklable)
+            "cfg": cfg,
+            "engine_kwargs": dict(engine_kwargs),
+            "device_index": int(device_index),
+            "place": bool(place),
+            "heartbeat_interval_s": float(heartbeat_interval_s),
+            "rss_limit_mb": int(rss_limit_mb),
+            "faults": fault_plan,
+            "idle_sleep_s": float(idle_sleep_s),
+        }
+        # spawn, not fork: the parent holds a live jax runtime, and a
+        # forked copy of it is undefined behaviour — the child builds
+        # its own interpreter and its own jax client from scratch,
+        # which is the entire point of the isolation
+        ctx = mp.get_context("spawn")
+        self._conn, child_end = ctx.Pipe(duplex=True)
+        self._proc = ctx.Process(
+            target=worker_mod.worker_main, args=(spec, child_end),
+            daemon=True, name=f"serve-worker-{index}")
+        self._proc.start()
+        # the parent MUST close its copy of the child's end: the child
+        # detects parent death as EOF on the pipe, which only happens
+        # when no live process holds a write handle to this end
+        child_end.close()
+        self.pid = self._proc.pid
+        self.started_t = self.clock()
+
+        # lifecycle flags (single-owner: control thread / sync driver)
+        self.ready = False
+        self.fenced = False
+        self.crashed = False            # child shipped a CRASH frame
+        self.poisoned = False           # protocol error: fence me
+        self.bye = False                # clean goodbye received
+        self.last_error = ""
+
+        # the shadow: every handle routed here and not yet resolved —
+        # the reclaim surface, owned and trusted by the parent only
+        self.shadow: Dict[int, S.RequestHandle] = {}
+
+        # last-frame mirror of the child engine's state
+        self.counter_state = {k: 0 for k in COUNTERS}
+        self.progress: Dict[int, int] = {}
+        self.active = 0
+        self.queued = 0
+        self.chunks = 0
+        self.compiling = True           # bring-up IS a compile phase
+        self.rss_mb = 0
+        self.pages_free = -1
+        self.last_heartbeat = self.clock()
+        self.stats_reply: Optional[dict] = None
+        # child-stamp -> parent-absorb lag per frame (the isolation tax
+        # bench_serve's --isolation leg reports); perf_counter is
+        # CLOCK_MONOTONIC on Linux — one epoch across processes
+        self.ipc_lag_s: deque = deque(maxlen=10_000)
+
+    def __getattr__(self, name):
+        # the COUNTERS surface (tokens_decoded, decode_traces, ...)
+        # mirrors the last frame — this is what lets the replica set's
+        # _agg()/stats() read a client exactly like an Engine
+        counters = self.__dict__.get("counter_state")
+        if counters is not None and name in counters:
+            return counters[name]
+        raise AttributeError(name)
+
+    # -- sending ------------------------------------------------------------
+
+    def _send(self, kind: str, payload: dict) -> bool:
+        try:
+            self._conn.send_bytes(encode_frame(kind, payload))
+            return True
+        except (OSError, ValueError, BrokenPipeError) as e:
+            # a dead pipe is not a protocol lie — PID liveness decides
+            # what happened; just record it for the failover reason
+            if not self.last_error:
+                self.last_error = f"pipe write failed: {e!r}"
+            return False
+
+    def route(self, handles: List[S.RequestHandle]) -> None:
+        """Hand requests to the child. They enter the shadow FIRST: if
+        the write fails (child mid-death), the reclaim sweep still owns
+        them and they replay on a survivor — routed work is never lost
+        to a torn pipe."""
+        now = self.clock()
+        for h in handles:
+            self.shadow[h.request.request_id] = h
+        self._send(ADMIT, {"requests": [h.to_wire(now) for h in handles]})
+
+    def request_stats(self) -> None:
+        self._send(STATS_REQ, {})
+
+    # -- receiving ----------------------------------------------------------
+
+    def pump(self, poll_s: float = 0.0) -> bool:
+        """Drain and dispatch every complete frame the child has sent.
+        Returns True when any frame was processed. A fenced client
+        never pumps (late frames from a zombie must not fulfil
+        anything); a frame that fails to decode poisons the client —
+        the supervisor fences it on the next sweep."""
+        if self.fenced:
+            return False
+        did = False
+        first = True
+        while True:
+            try:
+                if not self._conn.poll(poll_s if first else 0):
+                    break
+                data = self._conn.recv_bytes()
+            except (EOFError, OSError):
+                break       # pipe closed: PID liveness tells the story
+            first = False
+            did = True
+            try:
+                kind, payload = decode_frame(data)
+                self._dispatch(kind, payload)
+            except IPCError as e:
+                self.poisoned = True
+                self.last_error = f"protocol error: {e}"
+                break
+        return did
+
+    def _dispatch(self, kind: str, payload: dict) -> None:
+        if kind == READY:
+            self.ready = True
+            self.compiling = True       # first chunks still compile
+            self.last_heartbeat = self.clock()
+            try:
+                self.rss_mb = int(payload.get("rss_mb", 0))
+            except (TypeError, ValueError):
+                raise IPCError(f"malformed READY: {payload!r}") from None
+        elif kind in (HEARTBEAT, HARVEST):
+            # results FIRST, snapshot second: the snapshot in a frame
+            # counts the completions whose results ride the same frame,
+            # so absorbing in this order keeps parent state consistent
+            # even if a later frame never arrives
+            if kind == HARVEST:
+                for d in payload.get("results", ()):
+                    self._absorb_result(d)
+            if payload.get("snap") is not None:
+                self._absorb_snapshot(payload["snap"])
+            self.last_heartbeat = self.clock()
+        elif kind == STATS:
+            reply = payload.get("stats")
+            if not isinstance(reply, dict):
+                raise IPCError(f"malformed STATS: {payload!r}")
+            self.stats_reply = reply
+        elif kind == CRASH:
+            self.crashed = True
+            self.last_error = str(payload.get("error", "child crash"))
+        elif kind == BYE:
+            self.bye = True
+        else:
+            raise IPCError(f"unexpected frame kind {kind!r} from child")
+
+    def _absorb_result(self, d: dict) -> None:
+        try:
+            result = S.Result.from_wire(d)
+        except (KeyError, TypeError, ValueError) as e:
+            raise IPCError(f"malformed result: {e!r}") from None
+        handle = self.shadow.pop(result.request_id, None)
+        if handle is None or handle.done():
+            return      # reclaimed+replayed already, or a stale echo
+        # honest caller-observed latency: restamp against the PARENT
+        # clock and the caller's real submit time (the child's stamps
+        # are relative to its own admission)
+        result.total_s = round(self.clock() - handle.request.submit_t, 6)
+        if self.on_done is not None:
+            self.on_done(handle, result)
+        else:
+            handle.fulfill(result)
+
+    def _absorb_snapshot(self, snap: dict) -> None:
+        (self.counter_state, self.progress, self.active, self.queued,
+         self.chunks, self.compiling, self.rss_mb, stamp,
+         self.pages_free) = _snap_fields(snap)
+        self.ipc_lag_s.append(max(time.perf_counter() - stamp, 0.0))
+
+    # -- supervision surface ------------------------------------------------
+
+    def active_slots(self) -> int:
+        return self.active
+
+    def inflight_handles(self) -> List[S.RequestHandle]:
+        return list(self.shadow.values())
+
+    def alive_proc(self) -> bool:
+        return self._proc.is_alive()
+
+    def exit_desc(self) -> str:
+        """Decode how the child died — the second liveness signal. A
+        negative exitcode is the terminating signal (SIGKILL for a host
+        OOM killer or `kill -9`, SIGSEGV for an XLA crash); exit 137 is
+        the worker's own RSS watchdog (container OOM convention)."""
+        code = self._proc.exitcode
+        if code is None:
+            return "running"
+        if code < 0:
+            try:
+                name = signal.Signals(-code).name
+            except ValueError:
+                name = f"signal {-code}"
+            return f"killed by {name}"
+        if code == OOM_EXIT:
+            return f"oom-killed (exit {OOM_EXIT}: child RSS limit)"
+        return f"exit code {code}"
+
+    # -- fencing / teardown -------------------------------------------------
+
+    def fence(self) -> None:
+        """One-way: after this, no frame from the child is ever
+        processed again — its requests belong to the reclaim sweep.
+        The pipe end is released too (a fenced client never reads or
+        writes again; holding the fd would leak one pipe per
+        failover on a long-lived server)."""
+        self.fenced = True
+        try:
+            self._conn.close()
+        except (OSError, AttributeError):
+            pass
+
+    def hard_kill(self, join_s: float = 5.0) -> None:
+        """SIGKILL the child (idempotent; a corpse stays dead). No
+        grace: by the time a replica is being fenced, its child is
+        crashed, wedged, or lying — all three deserve -9."""
+        if self._proc.is_alive():
+            try:
+                self._proc.kill()
+            except (OSError, ValueError):
+                pass
+        self._proc.join(join_s)
+
+    def salvage(self) -> None:
+        """After the child is down: drain every complete frame it wrote
+        before dying. Results that made it into the pipe fulfil their
+        handles (they will NOT be replayed); the final snapshot brings
+        the counter mirror to the last consistent state. Call BEFORE
+        ``fence`` — a fenced client drops frames."""
+        while self.pump():
+            pass
+
+    def reclaim(self) -> List[S.RequestHandle]:
+        """Every routed, still-open handle — the replay set. Clears the
+        shadow; call exactly once, after ``salvage`` + ``fence``."""
+        out = [h for h in self.shadow.values() if not h.done()]
+        self.shadow.clear()
+        return out
+
+    def retire_counters(self,
+                        reclaimed: List[S.RequestHandle]) -> Dict[str, int]:
+        """The dead child's counters minus the reclaimed requests'
+        harvested prefixes (per the last frame's progress map): replay
+        re-credits every token, so this keeps the set's aggregates
+        counting distinct delivered tokens across a hard kill."""
+        out = dict(self.counter_state)
+        for h in reclaimed:
+            n = self.progress.get(h.request.request_id, 0)
+            out["tokens_decoded"] -= n
+            out["occupancy_sum"] -= n
+        return out
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Graceful shutdown: ask, wait, then kill. Frames written
+        before the child exited are salvaged either way."""
+        if self._proc.is_alive():
+            self._send(SHUTDOWN, {})
+            self._proc.join(timeout)
+        self.hard_kill()
+        self.salvage()
+        self.fence()

@@ -66,6 +66,25 @@ one decode compile per replica), or ``start()`` for live traffic
 i's engine is committed to device ``i % len(devices)`` so the replicas'
 fused chunks genuinely overlap — on a pod slice that is replica-per-
 chip serving; on the CPU fallback it still overlaps the async dispatch.
+
+ISOLATION SHAPES. ``isolation='thread'`` (the default) is the above:
+replicas are threads sharing this process — cheap, transfer-guardable,
+but a segfault in XLA, a host OOM, or a `kill -9` still takes the whole
+set down. ``isolation='process'`` runs each replica's engine in a
+SPAWNED CHILD PROCESS (own interpreter, own jax client, pinned to its
+device — ``serve/worker.py``) behind the typed IPC layer in
+``serve/ipc.py``. The fence/reclaim/replay protocol is identical; what
+changes is who holds the truth: the parent keeps a SHADOW of every
+handle routed to a child (``ChildEngineClient.shadow``) and reclaims
+from that, never from the child — a SIGKILLed process answers nothing.
+Supervision gains a second liveness signal: child PID liveness with
+exit-code/signal decoding (SIGKILL, SIGSEGV, the exit-137 RSS-watchdog
+OOM convention) layered on top of the same missed-heartbeat deadline,
+where heartbeats are now frames on the pipe rather than a shared-heap
+timestamp. A hard-killed child is fenced exactly like a crash or hang:
+its pipe is drained for frames written before death (those results
+stand), everything still open replays byte-identically on a survivor,
+and the dead replica restarts through the same circuit-breaker backoff.
 """
 
 from __future__ import annotations
@@ -75,15 +94,14 @@ import time
 from typing import Callable, List, Optional
 
 from dalle_pytorch_tpu.serve import scheduler as S
+from dalle_pytorch_tpu.serve.engine import COUNTERS as _COUNTERS
 
 # replica lifecycle states (``replica_states()`` / ``stats()``)
 RUNNING = "running"
 BROKEN = "broken"        # circuit open: waiting out the bring-up backoff
 DRAINED = "drained"      # operator drain: down until undrain_replica()
 
-_COUNTERS = ("tokens_decoded", "decode_steps", "harvests",
-             "occupancy_sum", "completed", "expired",
-             "decode_traces", "prefill_traces", "evicted")
+ISOLATION_MODES = ("thread", "process")
 
 
 class _Replica:
@@ -93,7 +111,7 @@ class _Replica:
 
     __slots__ = ("index", "state", "engine", "queue", "thread", "stop",
                  "device", "attempt", "bringups", "next_bringup_t",
-                 "last_error", "dead")
+                 "last_error", "dead", "await_ready", "last_exit")
 
     def __init__(self, index: int, device=None):
         self.index = index
@@ -108,6 +126,8 @@ class _Replica:
         self.next_bringup_t = 0.0
         self.last_error = ""
         self.dead = False            # loop thread recorded a crash
+        self.await_ready = False     # process child spawned, READY due
+        self.last_exit = ""          # decoded exit of the last child
 
 
 class ReplicaSet:
@@ -132,13 +152,25 @@ class ReplicaSet:
                  heartbeat_s: float = 5.0,
                  bringup_policy=None,
                  place_on_devices: bool = True,
-                 idle_sleep_s: float = 0.002):
+                 idle_sleep_s: float = 0.002,
+                 isolation: str = "thread",
+                 child_rss_limit_mb: int = 0,
+                 spawn_timeout_s: float = 120.0,
+                 compile_grace_s: float = 120.0):
         import jax
 
+        from dalle_pytorch_tpu.resilience import faults
         from dalle_pytorch_tpu.resilience import retry as rretry
 
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if isolation not in ISOLATION_MODES:
+            raise ValueError(f"isolation must be one of "
+                             f"{ISOLATION_MODES}, got {isolation!r}")
+        # the CLI-harness fault path (DALLE_FAULTS): child plans are cut
+        # at spawn time, so the env plan must be live before the first
+        # bring-up — no-op when unset or already active
+        faults.maybe_activate_from_env()
         self.params = params
         self.cfg = cfg
         self.queue = queue
@@ -148,11 +180,37 @@ class ReplicaSet:
         self.clock = clock
         self.heartbeat_s = float(heartbeat_s)
         self.kv = str(kv)
+        self.isolation = str(isolation)
+        self.child_rss_limit_mb = int(child_rss_limit_mb)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.compile_grace_s = float(compile_grace_s)
         self._engine_kwargs = dict(
             num_slots=num_slots, chunk_steps=chunk_steps,
             prefill_buckets=prefill_buckets, metrics=metrics,
             log_every=log_every, quantize_cache=quantize_cache,
             kv=kv, page_size=page_size, num_pages=num_pages)
+        if self.isolation == "process":
+            import numpy as np
+            # what crosses the spawn boundary: a host numpy pytree of
+            # the params (one device_get here, one upload in the child
+            # — the child owns its own device copy), and a picklable
+            # subset of the engine kwargs (the metrics sink stays in
+            # the parent; supervision events are parent-side)
+            self._np_params = jax.tree.map(np.asarray, params)
+            self._child_kwargs = dict(
+                num_slots=num_slots, chunk_steps=chunk_steps,
+                prefill_buckets=prefill_buckets,
+                quantize_cache=quantize_cache,
+                kv=kv, page_size=page_size, num_pages=num_pages)
+            # routing needs page math without an Engine in-process:
+            # mirror the engine's bucket/page-size resolution
+            self._buckets = (S.prefill_buckets(cfg.text_seq_len)
+                             if prefill_buckets is None
+                             else tuple(sorted(set(
+                                 int(b) for b in prefill_buckets))))
+            self._page_size = (int(page_size)
+                               or min(16, cfg.seq_len)) if kv == "paged" \
+                else 0
         # circuit-breaker backoff between bring-up attempts; serving
         # wants short first retries and a firm cap, not training's
         # minutes-scale defaults
@@ -212,12 +270,31 @@ class ReplicaSet:
         r.bringups += 1
         try:
             faults.on_replica_bringup(r.index, attempt)
-            queue = S.RequestQueue(
-                max_depth=4 * self._engine_kwargs["num_slots"] + 8,
-                clock=self.clock)
-            engine = Engine(self.params, self.cfg, queue,
-                            complete=self.complete, clock=self.clock,
-                            device=r.device, **self._engine_kwargs)
+            if self.isolation == "process":
+                from dalle_pytorch_tpu.serve import ipc
+                client = ipc.ChildEngineClient(
+                    self._np_params, self.cfg,
+                    index=r.index,
+                    engine_kwargs=self._child_kwargs,
+                    device_index=r.index,
+                    place=self._placed,
+                    heartbeat_interval_s=min(
+                        max(self.heartbeat_s / 5, 0.01), 0.25),
+                    rss_limit_mb=self.child_rss_limit_mb,
+                    # hard-fault plans cross the boundary ONCE per
+                    # activation per replica (fire-once must outlive
+                    # the child — see faults.child_plan_for)
+                    fault_plan=faults.child_plan_for(r.index),
+                    idle_sleep_s=self._idle_sleep_s,
+                    clock=self.clock,
+                    on_done=self._child_done)
+            else:
+                queue = S.RequestQueue(
+                    max_depth=4 * self._engine_kwargs["num_slots"] + 8,
+                    clock=self.clock)
+                engine = Engine(self.params, self.cfg, queue,
+                                complete=self.complete, clock=self.clock,
+                                device=r.device, **self._engine_kwargs)
         except Exception as e:  # noqa: BLE001 — circuit-break, don't die
             r.attempt += 1
             self.bringup_failures += 1
@@ -229,6 +306,19 @@ class ReplicaSet:
                         attempt=attempt, consecutive=r.attempt,
                         backoff_s=round(delay, 3), error=repr(e))
             return False
+        if self.isolation == "process":
+            # the spawn is async: the child is importing jax and
+            # building its engine. RUNNING means "spawned"; routing is
+            # gated on client.ready, and _check_replicas turns a child
+            # that dies or stalls before READY into a bring-up failure
+            # (with backoff), not a failover — there is nothing to
+            # reclaim yet. r.attempt resets when READY lands.
+            r.engine, r.queue = client, None
+            r.dead = False
+            r.await_ready = True
+            r.stop = None
+            r.state = RUNNING
+            return True
         # an orphan is a handle the fenced engine popped but never
         # admitted (fence landed mid-step): back to the shared queue
         engine.on_fenced_orphan = \
@@ -245,6 +335,17 @@ class ReplicaSet:
             self._spawn(r)
         return True
 
+    def _child_done(self, handle: S.RequestHandle,
+                    result: S.Result) -> None:
+        """Completion hand-off for process-mode results (the client's
+        ``on_done``): same contract as ``Engine._finish`` — OK results
+        flow downstream (postprocess), everything else fulfils the
+        handle directly."""
+        if result.status == S.OK and self.complete is not None:
+            self.complete(handle, result)
+        else:
+            handle.fulfill(result)
+
     # -- fencing and reclaim (failover / drain) -----------------------------
 
     def _fence_and_reclaim(self, r: _Replica, now: float,
@@ -256,7 +357,18 @@ class ReplicaSet:
         from that point the old engine cannot fulfil, complete, or
         requeue anything, so the reclaim sweep is the single owner of
         these handles (a wedge waking later hits the fence, and
-        ``fulfill`` being first-write-wins closes the last window)."""
+        ``fulfill`` being first-write-wins closes the last window).
+
+        Process mode inverts one step on purpose: the child is KILLED
+        first (SIGKILL — crashed, wedged, or lying, all three deserve
+        -9), then the pipe is drained for frames written before death
+        (salvaged results stand and are NOT replayed; the final
+        snapshot is the last consistent counter state), and only then
+        is the client fenced and the shadow reclaimed. Killing before
+        salvaging is what makes the drain safe: a dead writer cannot
+        extend the stream while we read it."""
+        if self.isolation == "process":
+            return self._fence_and_reclaim_child(r, now, reason)
         eng, q = r.engine, r.queue
         r.engine, r.queue, r.thread = None, None, None
         if r.stop is not None:
@@ -307,6 +419,41 @@ class ReplicaSet:
                     reason=reason, reclaimed=reclaimed)
         return reclaimed
 
+    def _fence_and_reclaim_child(self, r: _Replica, now: float,
+                                 reason: str) -> int:
+        """The process-mode half of ``_fence_and_reclaim`` (see its
+        docstring): kill -> salvage -> fence -> reclaim-from-shadow."""
+        client = r.engine
+        r.engine, r.queue, r.thread = None, None, None
+        r.await_ready = False
+        reclaimed = 0
+        if client is not None:
+            # how the child died, honestly: a child that was already
+            # dead when we got here died on its own (signal/OOM/crash
+            # — the decoded exit is the story); a child WE are killing
+            # (drain, hang, protocol error) must not advertise
+            # 'killed by SIGKILL' as if the OS had done it
+            died_on_its_own = not client.alive_proc()
+            client.hard_kill()
+            r.last_exit = (client.exit_desc() if died_on_its_own
+                           else f"hard-killed by supervisor ({reason})")
+            client.salvage()
+            client.fence()
+            handles = client.reclaim()
+            retire = client.retire_counters(handles)
+            for k in _COUNTERS:
+                self._retired[k] += retire.get(k, 0)
+            for h in handles:
+                # original arrival position: zero-loss AND no
+                # queue-jumping, same as the thread path
+                self.queue.requeue(h)
+                reclaimed += 1
+        self.reclaimed += reclaimed
+        self._event("serve_replica_fenced", replica=r.index,
+                    reason=reason, reclaimed=reclaimed,
+                    exit=r.last_exit)
+        return reclaimed
+
     def _failover(self, r: _Replica, now: float, reason: str) -> None:
         self.failovers += 1
         self._fence_and_reclaim(r, now, reason)
@@ -347,7 +494,9 @@ class ReplicaSet:
         and crashes surface synchronously in ``step_once``."""
         did = False
         for r in self.replicas:
-            if r.state == RUNNING:
+            if r.state == RUNNING and self.isolation == "process":
+                did = self._check_child(r, now) or did
+            elif r.state == RUNNING:
                 if r.dead:
                     self._failover(r, now,
                                    reason=f"crash: {r.last_error}")
@@ -372,6 +521,107 @@ class ReplicaSet:
                 did = self._bring_up(r, now) or did
         return did
 
+    def _check_child(self, r: _Replica, now: float) -> bool:
+        """One supervision check of a RUNNING process replica — the two
+        liveness signals layered: PID liveness with exit decoding (a
+        SIGKILL/SIGSEGV/OOM death answers at the OS level even though
+        the child can say nothing), then the missed-heartbeat deadline
+        over the frame stream (a process that is alive but silent is
+        wedged — it gets hard-killed and fenced like a hang). A child
+        that dies BEFORE its READY frame is a bring-up failure, not a
+        failover: it never held work, so it re-enters the circuit-
+        breaker backoff with nothing to reclaim."""
+        c = r.engine
+        if c is None:
+            return False
+        if not c.ready:
+            if c.crashed or c.poisoned or not c.alive_proc():
+                c.hard_kill()
+                self._bringup_fail_async(
+                    r, now, f"child died in bring-up: "
+                            f"{c.last_error or c.exit_desc()}")
+                return True
+            if now - c.started_t > self.spawn_timeout_s:
+                c.hard_kill()
+                self._bringup_fail_async(
+                    r, now, f"child bring-up exceeded "
+                            f"{self.spawn_timeout_s:g}s")
+                return True
+            return False
+        if c.crashed:
+            r.last_error = f"crash: {c.last_error}"
+            self._failover(r, now, reason=r.last_error)
+        elif c.poisoned:
+            r.last_error = c.last_error
+            self._failover(r, now, reason=r.last_error)
+        elif not c.alive_proc():
+            r.last_error = f"child exited: {c.exit_desc()}"
+            self._failover(r, now, reason=r.last_error)
+        else:
+            # compiling exempts a child from the tight deadline but not
+            # forever: compile_grace_s caps how long "still compiling"
+            # is believable without a single frame. The failover reason
+            # names the deadline that actually expired.
+            if c.compiling:
+                deadline, which = (max(self.heartbeat_s,
+                                       self.compile_grace_s),
+                                   "compile grace")
+            else:
+                deadline, which = self.heartbeat_s, "heartbeat"
+            if now - c.last_heartbeat <= deadline:
+                return False
+            self._failover(
+                r, now,
+                reason=f"missed {which} deadline (> {deadline:g}s: "
+                       f"hang)")
+        return True
+
+    def _bringup_fail_async(self, r: _Replica, now: float,
+                            msg: str) -> None:
+        """A spawned child that died or stalled before READY: count it
+        against the circuit breaker exactly like a synchronous
+        constructor failure."""
+        c = r.engine
+        r.engine, r.queue = None, None
+        r.await_ready = False
+        if c is not None:
+            r.last_exit = c.exit_desc()
+            c.fence()               # releases the dead child's pipe
+            # routing is gated on ready, so the shadow is normally
+            # empty — but never drop a handle on principle
+            for h in c.reclaim():
+                self.queue.requeue(h)
+        r.attempt += 1
+        self.bringup_failures += 1
+        delay = self.bringup_policy.backoff(min(r.attempt - 1, 20))
+        r.next_bringup_t = now + delay
+        r.last_error = msg
+        r.state = BROKEN
+        self._event("serve_replica_bringup_fail", replica=r.index,
+                    attempt=r.bringups - 1, consecutive=r.attempt,
+                    backoff_s=round(delay, 3), error=msg,
+                    exit=r.last_exit)
+
+    def _pump_children(self, now: float) -> bool:
+        """Drain every live child's pipe: absorb heartbeats/snapshots,
+        fulfil harvested results, notice READY transitions. The one
+        place process-mode results enter the parent — called from the
+        control loop (threaded) and ``step_once`` (sync drive)."""
+        did = False
+        for r in self.replicas:
+            c = r.engine
+            if r.state != RUNNING or c is None:
+                continue
+            did = c.pump() or did
+            if r.await_ready and c.ready:
+                r.await_ready = False
+                r.attempt = 0
+                r.last_error = ""
+                self._event("serve_replica_up", replica=r.index,
+                            bringups=r.bringups, pid=c.pid)
+                did = True
+        return did
+
     # -- routing ------------------------------------------------------------
 
     def _expire(self, h: S.RequestHandle, now: float) -> None:
@@ -387,6 +637,12 @@ class ReplicaSet:
             total_s=round(now - req.submit_t, 6)))
 
     def _capacity(self, r: _Replica) -> int:
+        if self.isolation == "process":
+            # parent-authoritative: the shadow (routed, unresolved) is
+            # the truth; the child's own reports lag a frame. Allow one
+            # queued wave beyond the slot pool so the child can prefill
+            # its next group while decoding the current one.
+            return max(0, 2 * r.engine.num_slots - len(r.engine.shadow))
         return max(0, r.engine.num_slots - r.engine.active_slots()
                    - r.queue.depth())
 
@@ -402,11 +658,21 @@ class ReplicaSet:
             eng = r.engine
             fits, free_pages = True, 0
             if eng.kv == "paged":
-                free_pages = eng.alloc.free
+                if self.isolation == "process":
+                    # last-frame view: pages_free lags one heartbeat
+                    # (-1 = no frame yet -> stay optimistic); the
+                    # child's own admission gate is the authority
+                    free_pages = eng.pages_free
+                    buckets, page_size = self._buckets, self._page_size
+                    if free_pages < 0:
+                        return (True, caps[r.index], 0, -r.index)
+                else:
+                    free_pages = eng.alloc.free
+                    buckets, page_size = eng.buckets, eng.page_size
                 try:
                     need = KV.pages_for(
-                        S.bucket_for(len(h.request.codes), eng.buckets),
-                        eng.page_size)
+                        S.bucket_for(len(h.request.codes), buckets),
+                        page_size)
                     fits = free_pages >= need
                 except ValueError:
                     # an over-long prompt buckets nowhere; the engine's
@@ -424,16 +690,30 @@ class ReplicaSet:
         zero live replicas, a dead entry must get its typed result."""
         live = [r for r in self.replicas
                 if r.state == RUNNING and r.engine is not None]
+        if self.isolation == "process":
+            # routable = READY and believable: not poisoned/crashed and
+            # the PID is live RIGHT NOW — never route into a corpse in
+            # the window before the next supervision sweep fences it
+            live = [r for r in live
+                    if r.engine.ready and not r.engine.poisoned
+                    and not r.engine.crashed and not r.engine.fenced
+                    and r.engine.alive_proc()]
         caps = {r.index: self._capacity(r) for r in live}
         total = sum(caps.values())
         ready, expired = self.queue.pop_ready(total, now)
         for h in expired:
             self._expire(h, now)
+        assigned: dict = {}
         for h in ready:
             cands = [r for r in live if caps[r.index] > 0]
             r = self._pick(cands, caps, h)
             caps[r.index] -= 1
-            r.queue.requeue(h, count=False)
+            if self.isolation == "process":
+                assigned.setdefault(r.index, (r, []))[1].append(h)
+            else:
+                r.queue.requeue(h, count=False)
+        for r, batch in assigned.values():
+            r.engine.route(batch)       # one admit frame per replica
         return bool(ready or expired)
 
     # -- the replica loop (threaded mode) -----------------------------------
@@ -475,11 +755,17 @@ class ReplicaSet:
                 stop.wait(self._idle_sleep_s)
 
     def _run_control(self, stop: threading.Event) -> None:
-        """Routing + supervision loop (threaded mode)."""
+        """Routing + supervision loop (threaded mode). In process mode
+        this is the ONLY parent-side loop: the children drive their own
+        engines, and this thread pumps their pipes, routes, and
+        supervises."""
         while not stop.is_set():
             now = self.clock()
             with self._ctl_lock:
-                busy = self._check_replicas(now)
+                busy = False
+                if self.isolation == "process":
+                    busy = self._pump_children(now)
+                busy = self._check_replicas(now) or busy
                 busy = self._route(now) or busy
             stop.wait(0.0005 if busy else self._idle_sleep_s)
 
@@ -491,9 +777,10 @@ class ReplicaSet:
         self._started = True
         if self._t_start is None:       # threaded mode never steps
             self._t_start = self.clock()  # sync, so stamp elapsed here
-        for r in self.replicas:
-            if r.state == RUNNING and r.thread is None:
-                self._spawn(r)
+        if self.isolation != "process":  # children ARE the loops
+            for r in self.replicas:
+                if r.state == RUNNING and r.thread is None:
+                    self._spawn(r)
         self._ctl_stop = threading.Event()
         self._ctl_thread = threading.Thread(
             target=self._run_control, args=(self._ctl_stop,),
@@ -513,6 +800,23 @@ class ReplicaSet:
         self._ctl_stop.set()
         if self._ctl_thread is not None:
             self._ctl_thread.join(timeout)
+        if self.isolation == "process":
+            with self._ctl_lock:
+                for r in self.replicas:
+                    c = r.engine
+                    if c is None:
+                        continue
+                    left = max(0.5, timeout - (time.perf_counter() - t0))
+                    # graceful SHUTDOWN -> join -> SIGKILL straggler;
+                    # close() salvages the pipe and fences, so a child
+                    # outliving its join can never fulfil anything late
+                    c.close(left / max(self.n_replicas, 1))
+                    for h in c.reclaim():
+                        h.fulfill(S.Result(
+                            status=S.CANCELLED,
+                            request_id=h.request.request_id,
+                            reason="server shutdown"))
+            return
         with self._ctl_lock:
             for r in self.replicas:
                 if r.stop is not None:
@@ -550,8 +854,19 @@ class ReplicaSet:
         if self._t_start is None:
             self._t_start = now
         with self._ctl_lock:
-            did = self._check_replicas(now)
+            did = False
+            if self.isolation == "process":
+                did = self._pump_children(now)
+            did = self._check_replicas(now) or did
             did = self._route(now) or did
+        if self.isolation == "process":
+            # the children step themselves; the parent's "step" is the
+            # pump/supervise/route above. Nap briefly when nothing
+            # moved so run_until_idle doesn't hot-spin while children
+            # decode at their own pace.
+            if not did:
+                time.sleep(0.001)
+            return did
         for r in list(self.replicas):
             if r.state != RUNNING or r.engine is None:
                 continue
@@ -573,6 +888,11 @@ class ReplicaSet:
     def idle(self) -> bool:
         if self.queue.depth() > 0:
             return False
+        if self.isolation == "process":
+            # the shadow is the parent-side truth: anything routed and
+            # unresolved is still in flight somewhere
+            return all(not r.engine.shadow for r in self.replicas
+                       if r.engine is not None)
         for r in self.replicas:
             if r.queue is not None and r.queue.depth() > 0:
                 return False
@@ -624,21 +944,40 @@ class ReplicaSet:
         for r in self.replicas:
             if r.state != RUNNING or r.engine is None:
                 continue
-            if r.thread is None or r.thread.is_alive():
+            if self.isolation == "process":
+                if r.engine.alive_proc():
+                    return True
+            elif r.thread is None or r.thread.is_alive():
                 return True
         return False
 
     def replica_states(self) -> List[dict]:
+        """Per-replica /healthz body. Process mode adds the supervised-
+        child facts an operator triages with: the child PID, its
+        restart count, the decoded last exit (signal name / OOM exit
+        137 / plain code), and the child's reported RSS."""
         now = self.clock()
         out = []
         for r in self.replicas:
-            alive = r.state == RUNNING and r.engine is not None and \
-                (r.thread is None or r.thread.is_alive())
+            if self.isolation == "process":
+                alive = r.state == RUNNING and r.engine is not None \
+                    and r.engine.alive_proc()
+            else:
+                alive = r.state == RUNNING and r.engine is not None and \
+                    (r.thread is None or r.thread.is_alive())
             rec = {"replica": r.index, "state": r.state, "alive": alive,
                    "bringups": r.bringups}
             if r.engine is not None:
                 rec["heartbeat_age_s"] = round(
                     max(now - r.engine.last_heartbeat, 0.0), 4)
+            if self.isolation == "process":
+                rec["restarts"] = max(r.bringups - 1, 0)
+                if r.engine is not None:
+                    rec["pid"] = r.engine.pid
+                    rec["rss_mb"] = r.engine.rss_mb
+                    rec["ready"] = r.engine.ready
+                if r.last_exit:
+                    rec["last_exit"] = r.last_exit
             if r.last_error:
                 rec["last_error"] = r.last_error
             out.append(rec)
@@ -655,6 +994,7 @@ class ReplicaSet:
         elapsed = None if self._t_start is None \
             else max(self.clock() - self._t_start, 1e-9)
         live = [r for r in self.replicas if r.engine is not None]
+        proc = self.isolation == "process"
         per = []
         for r in self.replicas:
             rec = {"replica": r.index, "state": r.state}
@@ -662,19 +1002,34 @@ class ReplicaSet:
                 e = r.engine
                 rec.update({
                     "active_slots": e.active_slots(),
-                    "queued": r.queue.depth() if r.queue else 0,
+                    # routed-but-not-decoding: the shadow holds EVERY
+                    # outstanding request (in-slot ones included), so
+                    # subtract the active count rather than adding the
+                    # child's own queue depth on top — same meaning as
+                    # thread mode's private-queue depth
+                    "queued": (max(len(e.shadow) - e.active_slots(), 0)
+                               if proc
+                               else (r.queue.depth() if r.queue else 0)),
                     "decode_compiles": e.decode_traces,
                     "prefill_compiles": e.prefill_traces,
                     "completed": e.completed,
                     "tokens_decoded": e.tokens_decoded,
                 })
-                if e.kv == "paged":
+                if proc:
+                    rec.update({"pid": e.pid, "rss_mb": e.rss_mb,
+                                "restarts": max(r.bringups - 1, 0)})
+                    if r.last_exit:
+                        rec["last_exit"] = r.last_exit
+                    if e.kv == "paged" and e.pages_free >= 0:
+                        rec["pages_free"] = e.pages_free
+                elif e.kv == "paged":
                     rec["pages_free"] = e.alloc.free
             per.append(rec)
         tokens = self.tokens_decoded
         steps = self.decode_steps
         return {
             "replicas": self.n_replicas,
+            "isolation": self.isolation,
             "alive_replicas": sum(
                 1 for r in self.replicas
                 if r.state == RUNNING and r.engine is not None),

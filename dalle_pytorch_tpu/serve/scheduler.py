@@ -139,6 +139,46 @@ class Request:
             return None
         return self.submit_t + self.deadline_s
 
+    def to_wire(self, now: float) -> dict:
+        """Flat-dict form for the process-isolation IPC (serve/ipc.py
+        frames, versions, and checksums it). Two clocks never cross the
+        boundary: the deadline ships as the REMAINING budget at send
+        time (``perf_counter`` bases differ between processes), and the
+        receiver re-anchors it on its own clock. Every field is a JSON
+        scalar/list, so the round trip is exact — ints verbatim, floats
+        via repr round-tripping — which is what lets a replayed request
+        decode bit-identically on a survivor in another process."""
+        return {
+            "id": int(self.request_id),
+            "codes": [int(c) for c in self.codes],
+            "seed": int(self.seed),
+            "priority": int(self.priority),
+            "temperature": float(self.sampling.temperature),
+            "filter_thres": float(self.sampling.filter_thres),
+            "top_p": float(self.sampling.top_p),
+            "deadline_left_s": (None if self.deadline_s is None
+                                else max(self.deadline_t - now, 0.0)),
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict, now: float) -> "Request":
+        """Inverse of ``to_wire``, validating by construction (the
+        ``SamplingParams`` range checks run again on this side — a
+        corrupt frame becomes a typed error, never a poisoned engine).
+        ``submit_t`` is re-anchored to the receiver's clock."""
+        deadline = d["deadline_left_s"]
+        return cls(
+            codes=tuple(int(c) for c in d["codes"]),
+            seed=int(d["seed"]),
+            sampling=SamplingParams(
+                temperature=float(d["temperature"]),
+                filter_thres=float(d["filter_thres"]),
+                top_p=float(d["top_p"])),
+            priority=int(d["priority"]),
+            deadline_s=None if deadline is None else float(deadline),
+            request_id=int(d["id"]),
+            submit_t=float(now))
+
 
 @dataclasses.dataclass
 class Result:
@@ -163,6 +203,44 @@ class Result:
     @property
     def ok(self) -> bool:
         return self.status == OK
+
+    def to_wire(self) -> dict:
+        """Flat-dict form for the process-isolation IPC. Token arrays
+        ship as plain int lists; ``image``/``clip_score`` never cross
+        the boundary (the child runs decode only — VAE/CLIP postprocess
+        stays in the parent, downstream of the fulfilled handle)."""
+        return {
+            "id": int(self.request_id),
+            "status": str(self.status),
+            "tokens": (None if self.tokens is None
+                       else [int(t) for t in self.tokens]),
+            "text_tokens": (None if self.text_tokens is None
+                            else [int(t) for t in self.text_tokens]),
+            "reason": str(self.reason),
+            "queued_s": float(self.queued_s),
+            "decode_s": float(self.decode_s),
+            "total_s": float(self.total_s),
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "Result":
+        status = str(d["status"])
+        if status not in (OK, REJECTED, DEADLINE_EXCEEDED, CANCELLED,
+                          ERROR):
+            raise ValueError(f"unknown Result.status {status!r}")
+        import numpy as np
+        toks = d["tokens"]
+        text = d["text_tokens"]
+        return cls(
+            status=status, request_id=int(d["id"]),
+            tokens=None if toks is None else np.asarray(
+                [int(t) for t in toks], np.int32),
+            text_tokens=None if text is None else np.asarray(
+                [int(t) for t in text], np.int32),
+            reason=str(d["reason"]),
+            queued_s=float(d["queued_s"]),
+            decode_s=float(d["decode_s"]),
+            total_s=float(d["total_s"]))
 
 
 class RequestHandle:
@@ -206,6 +284,24 @@ class RequestHandle:
                 f"request {self.request.request_id} not done after "
                 f"{timeout}s (still queued or decoding)")
         return self._result
+
+    def to_wire(self, now: float) -> dict:
+        """The request's wire form plus the handle-level ``queue_seq`` —
+        the original arrival position MUST survive the process boundary,
+        or a request reclaimed from a dead child and replayed would lose
+        its no-starvation guarantee (``requeue`` re-enters at
+        ``queue_seq``)."""
+        return {**self.request.to_wire(now), "seq": int(self.queue_seq)}
+
+    @classmethod
+    def from_wire(cls, d: dict, now: float) -> "RequestHandle":
+        """Child-side reconstruction: a LOCAL stand-in handle whose
+        fulfillment the worker observes and ships back as a result
+        frame — the parent's real handle (the caller's future) never
+        leaves the parent process."""
+        handle = cls(Request.from_wire(d, now))
+        handle.queue_seq = int(d["seq"])
+        return handle
 
 
 class RequestQueue:
@@ -350,6 +446,13 @@ class RequestQueue:
             while self._heap and len(ready) < n:
                 ready.append(heapq.heappop(self._heap)[2])
         return ready, [e[2] for e in dead]
+
+    def pending_prompt_lens(self) -> List[int]:
+        """Prompt lengths of everything currently queued — the engine's
+        ``compile_pending`` probe (is any queued prompt's bucket still
+        uncompiled?) without reaching into the heap layout."""
+        with self._lock:
+            return [len(entry[2].request.codes) for entry in self._heap]
 
     def drain(self) -> List[RequestHandle]:
         """Remove and return everything still queued (shutdown path — the

@@ -47,7 +47,12 @@ class InferenceServer:
     ``serve.replica.ReplicaSet`` — replica crash/hang/drain fails over
     with zero lost requests via deterministic replay, and capacity loss
     degrades to typed ``QueueFull`` backpressure (docs/SERVING.md
-    'Replica set & failover')."""
+    'Replica set & failover'). ``isolation='process'`` additionally
+    runs each replica's engine in a supervised child process, so a
+    SIGSEGV/SIGKILL/OOM kill of one replica cannot take the server
+    down (docs/SERVING.md 'Process isolation'); /healthz then reports
+    per-replica PID, restart count, last exit signal, and child RSS,
+    503 still only when ALL replicas are dead."""
 
     def __init__(self, params: dict, vae_params: dict, cfg, *,
                  num_slots: int = 4, queue_depth: int = 64,
@@ -59,6 +64,8 @@ class InferenceServer:
                  num_pages: int = 0,
                  replicas: int = 1,
                  heartbeat_s: float = 5.0,
+                 isolation: str = "thread",
+                 child_rss_limit_mb: int = 0,
                  clip_params: Optional[dict] = None, clip_cfg=None,
                  decode_images: bool = True,
                  metrics=None, log_every: int = 50,
@@ -70,6 +77,14 @@ class InferenceServer:
         self.init_deadline_s = init_deadline_s
         self.init_retries = init_retries
         self.replicas = int(replicas)
+        if isolation == "process" and self.replicas < 2:
+            # process isolation exists to keep the SET alive through a
+            # child death; a 1-replica process set is legal for the
+            # ReplicaSet API (restart-with-replay), but the server's
+            # contract is replicas>1 — fail loudly instead of serving a
+            # shape the operator almost certainly didn't mean
+            raise ValueError("isolation='process' requires replicas >= 2")
+        self.isolation = str(isolation)
 
         self.queue = S.RequestQueue(
             max_depth=queue_depth,
@@ -93,7 +108,8 @@ class InferenceServer:
                 complete=self._on_decoded, metrics=metrics,
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
-                heartbeat_s=heartbeat_s)
+                heartbeat_s=heartbeat_s, isolation=isolation,
+                child_rss_limit_mb=child_rss_limit_mb)
         else:
             self.engine = engine_mod.Engine(
                 params, cfg, self.queue, num_slots=num_slots,

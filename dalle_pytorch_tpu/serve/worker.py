@@ -1,0 +1,214 @@
+"""Child-process engine worker — the other end of ``serve/ipc.py``.
+
+``worker_main`` is the spawn entrypoint one process-isolated replica
+runs: build a private ``Engine`` (own jax client, pinned to this
+replica's device), then loop — drain parent frames, step the engine,
+ship completed results and heartbeat snapshots back. The worker holds
+no authority: every request it runs also lives in the parent's shadow
+bookkeeping, so this process can die AT ANY INSTRUCTION — SIGKILL,
+SIGSEGV, OOM — and the supervisor replays its open work byte-identically
+on a survivor. The invariants the worker does own:
+
+  * **Results and the counters that count them ride the same frame.**
+    A completion is shipped in a harvest frame whose snapshot already
+    includes it; the parent absorbs results before the snapshot. The
+    prefix of frames that survives a mid-write kill is therefore always
+    a consistent state (see ipc.py's module docstring).
+  * **A dead parent means exit, not a leak.** Every pipe read/write
+    and every idle nap goes through the connection; when the parent
+    dies the pipe EOFs/EPIPEs and the worker ``os._exit``\\ s — no
+    orphaned interpreters pinning devices after a parent crash.
+  * **Local handles are stand-ins.** Admitted requests become child-
+    local ``RequestHandle``\\ s (same request_id/queue_seq — replay
+    identity survives the boundary); the engine fulfils them locally
+    and the worker observes+ships the terminal result. The caller's
+    real future never leaves the parent.
+  * **The RSS watchdog dies loudly.** With ``rss_limit_mb`` set, the
+    worker checks its real RSS (/proc/self/statm) every iteration and
+    ``os._exit(137)``\\ s past the limit — the container OOM-kill
+    convention, and exactly the abrupt no-goodbye death the supervisor
+    must handle from a kernel OOM killer.
+  * **Known compiles announce themselves.** A cold decode program or
+    prefill bucket blocks this loop for seconds with no frames; the
+    worker sends a compiling=True heartbeat BEFORE such a step
+    (``Engine.compile_pending``), so the parent's hang deadline doesn't
+    read warm-up as a wedge and hard-kill a healthy child.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict
+
+from dalle_pytorch_tpu.serve import ipc
+from dalle_pytorch_tpu.serve import scheduler as S
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def rss_mb() -> int:
+    """Resident set size in MiB — /proc on Linux; elsewhere, the
+    ru_maxrss (PEAK, the best portable stand-in) with the platform's
+    units: bytes on macOS, KiB on the rest."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE // (1 << 20)
+    except (OSError, IndexError, ValueError):
+        import resource
+        import sys
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return peak >> 20 if sys.platform == "darwin" else peak >> 10
+
+
+def worker_main(spec: dict, conn) -> None:
+    """Spawn entrypoint (``multiprocessing`` 'spawn' context — never
+    fork a live jax runtime). Exit codes are part of the protocol:
+    0 clean (fence/shutdown), 1 crash (after a best-effort CRASH
+    frame), 3 parent-gone, 137 RSS watchdog. Signals show up as
+    negative exitcodes for the parent to decode."""
+    try:
+        _run(spec, conn)
+    except (EOFError, BrokenPipeError, ConnectionResetError):
+        os._exit(3)         # parent died: exit now, leak nothing
+    except MemoryError:
+        os._exit(ipc.OOM_EXIT)
+    except BaseException as e:  # noqa: BLE001 — ship the reason, then die
+        try:
+            conn.send_bytes(ipc.encode_frame(ipc.CRASH,
+                                             {"error": repr(e)}))
+        except Exception:   # noqa: BLE001 — the pipe may be gone too
+            pass
+        os._exit(1)
+    os._exit(0)
+
+
+def _run(spec: dict, conn) -> None:
+    from dalle_pytorch_tpu.resilience import faults
+
+    # the parent decides which plan (if any) this child gets — NOT the
+    # env var: fire-once for hard kills must outlive the child, so
+    # faults.child_plan_for hands a plan to a replica's first spawn
+    # only and a restarted child comes up clean
+    if spec.get("faults"):
+        faults.activate(faults.FaultPlan(**spec["faults"]))
+    rss_limit = int(spec.get("rss_limit_mb") or 0)
+    index = int(spec["index"])
+
+    import jax
+
+    from dalle_pytorch_tpu.serve.engine import Engine
+
+    devices = jax.devices()
+    device = (devices[int(spec["device_index"]) % len(devices)]
+              if spec.get("place") else None)
+    params = spec["params"]
+    if device is None:
+        # Engine device_puts params itself when placed; unplaced, do it
+        # here so the numpy pytree isn't re-uploaded every jit call
+        params = jax.device_put(params)
+    queue = S.RequestQueue(max_depth=1 << 30, clock=time.perf_counter)
+    engine = Engine(params, spec["cfg"], queue, complete=None,
+                    clock=time.perf_counter, device=device,
+                    **spec["engine_kwargs"])
+
+    open_handles: Dict[int, S.RequestHandle] = {}
+    conn.send_bytes(ipc.encode_frame(
+        ipc.READY, {"pid": os.getpid(), "device": str(device),
+                    "rss_mb": rss_mb()}))
+
+    hb_interval = float(spec.get("heartbeat_interval_s", 0.05))
+    idle_sleep = float(spec.get("idle_sleep_s", 0.002))
+    last_hb = 0.0
+
+    def send_snapshot(kind: str, results=None,
+                      compiling: bool = False) -> None:
+        nonlocal last_hb
+        chunks = engine.decode_steps // engine.chunk_steps
+        snap = ipc.engine_snapshot(engine, chunks, rss_mb(), compiling)
+        payload = {"snap": snap}
+        if results is not None:
+            payload["results"] = results
+        conn.send_bytes(ipc.encode_frame(kind, payload))
+        last_hb = time.perf_counter()
+
+    while True:
+        # 1. parent frames (admission + control). recv_bytes raising
+        # EOFError here IS the parent-death path worker_main handles.
+        while conn.poll(0):
+            kind, payload = ipc.decode_frame(conn.recv_bytes())
+            if kind == ipc.ADMIT:
+                now = time.perf_counter()
+                for d in payload["requests"]:
+                    h = S.RequestHandle.from_wire(d, now)
+                    open_handles[h.request.request_id] = h
+                    # requeue, not submit: the handle keeps the parent-
+                    # assigned request_id and arrival seq — replay
+                    # identity and ordering survive the boundary
+                    queue.requeue(h, count=False)
+            elif kind == ipc.FENCE:
+                engine.fence()
+                conn.send_bytes(ipc.encode_frame(
+                    ipc.BYE, {"reason": "fenced"}))
+                return
+            elif kind == ipc.SHUTDOWN:
+                engine.cancel_active("server shutdown")
+                for h in queue.drain():
+                    h.fulfill(S.Result(
+                        status=S.CANCELLED,
+                        request_id=h.request.request_id,
+                        reason="server shutdown"))
+                conn.send_bytes(ipc.encode_frame(
+                    ipc.BYE, {"reason": "shutdown"}))
+                return
+            elif kind == ipc.STATS_REQ:
+                conn.send_bytes(ipc.encode_frame(
+                    ipc.STATS, {"stats": engine.stats()}))
+            else:
+                raise ipc.IPCError(
+                    f"unexpected frame kind {kind!r} from parent")
+
+        chunks = engine.decode_steps // engine.chunk_steps
+        # the soft catalog (crash raises -> CRASH frame + exit 1; hang
+        # sleeps -> missed heartbeats -> the parent hard-kills) AND the
+        # hard catalog (real self-SIGKILL/SIGSEGV, OOM against the
+        # watchdog, a corrupt frame) both run here, making every serve
+        # fault process-drivable
+        faults.on_replica_chunk(index, chunks)
+        faults.on_worker_chunk(index, chunks,
+                               emit_frame=conn.send_bytes,
+                               rss_limit_mb=rss_limit, rss_mb=rss_mb)
+
+        # 2. RSS watchdog: die the way a container memory kill does —
+        # abruptly, with no goodbye frame, exit 137
+        if rss_limit and rss_mb() > rss_limit:
+            os._exit(ipc.OOM_EXIT)
+
+        # 3. announce a known-blocking compile BEFORE entering it
+        if engine.compile_pending():
+            send_snapshot(ipc.HEARTBEAT, compiling=True)
+
+        busy = engine.step_once()
+
+        # 4. ship completions. Batched under the pipe's atomic-write
+        # size; ONLY the final batch carries the snapshot, because the
+        # snapshot counts every completion in the sweep — a counter
+        # must never arrive ahead of the result it counted.
+        done = [rid for rid, h in open_handles.items() if h.done()]
+        if done:
+            wires = [open_handles.pop(rid).result(timeout=0).to_wire()
+                     for rid in done]
+            for i in range(0, len(wires), ipc.HARVEST_BATCH):
+                batch = wires[i:i + ipc.HARVEST_BATCH]
+                if i + ipc.HARVEST_BATCH >= len(wires):
+                    send_snapshot(ipc.HARVEST, results=batch)
+                else:
+                    conn.send_bytes(ipc.encode_frame(
+                        ipc.HARVEST, {"results": batch, "snap": None}))
+        elif time.perf_counter() - last_hb >= hb_interval:
+            send_snapshot(ipc.HEARTBEAT)
+
+        # 5. idle nap ON THE PIPE: wakes early for new admissions and
+        # notices a dead parent even with nothing to do
+        if not busy and engine.idle():
+            conn.poll(idle_sleep)

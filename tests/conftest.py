@@ -39,3 +39,12 @@ atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+# ... and through the ENVIRONMENT too: process-isolated serving tests
+# spawn child workers (serve/worker.py) that build their own jax from
+# env vars, not this process's jax.config — sharing the per-run cache
+# dir means every child's tiny engine compiles once across the whole
+# suite instead of once per spawned process.
+os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
+os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"

@@ -1,0 +1,373 @@
+"""The process-isolation IPC layer (serve/ipc.py + serve/worker.py).
+
+Three layers of proof, matching the layer's trust model:
+
+  * the SERIALIZER is exact: framed round trips for every queue/result
+    type — fuzzed requests (every sampling knob, priorities, deadlines)
+    and results of every terminal status come back bit-identical,
+    because deterministic replay across the process boundary depends on
+    the decoded request being the same request;
+  * CORRUPTION is typed, never trusted: truncated frames, bad magic,
+    version skew, flipped payload bytes (CRC), garbage JSON, and
+    malformed snapshot/result fields all raise ``IPCError`` — and a
+    client fed a garbage frame marks itself poisoned (the supervisor's
+    fence signal) instead of deadlocking or mis-parsing;
+  * a WORKER whose parent dies notices the broken pipe and exits
+    instead of leaking an interpreter that pins a device.
+
+The process-level failover semantics (SIGKILL mid-decode, OOM kills,
+shadow reclaim) live in tests/test_replica.py's process classes; this
+file owns the protocol itself.
+"""
+
+import multiprocessing as mp
+import random
+import time
+
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.serve import ipc
+from dalle_pytorch_tpu.serve import scheduler as S
+
+# ---------------------------------------------------------------------------
+# frame codec
+# ---------------------------------------------------------------------------
+
+
+class TestFrameCodec:
+    def test_round_trip_every_kind(self):
+        for kind in ipc.KINDS:
+            payload = {"kind": kind, "n": 3, "x": [1, 2.5, None, "s"]}
+            k2, p2 = ipc.decode_frame(ipc.encode_frame(kind, payload))
+            assert k2 == kind
+            assert p2 == payload
+
+    def test_empty_and_truncated_frames_raise(self):
+        with pytest.raises(ipc.IPCError, match="truncated"):
+            ipc.decode_frame(b"")
+        frame = ipc.encode_frame(ipc.HEARTBEAT, {"a": 1})
+        with pytest.raises(ipc.IPCError, match="truncated"):
+            ipc.decode_frame(frame[:4])
+
+    def test_truncated_payload_fails_checksum(self):
+        frame = ipc.encode_frame(ipc.HARVEST, {"results": [1, 2, 3]})
+        with pytest.raises(ipc.IPCError, match="checksum"):
+            ipc.decode_frame(frame[:-2])
+
+    def test_garbage_bytes_raise(self):
+        with pytest.raises(ipc.IPCError):
+            ipc.decode_frame(b"\xde\xad\xbe\xef not a frame")
+
+    def test_bad_magic(self):
+        frame = bytearray(ipc.encode_frame(ipc.BYE, {}))
+        frame[0] ^= 0xFF
+        with pytest.raises(ipc.IPCError, match="magic"):
+            ipc.decode_frame(bytes(frame))
+
+    def test_version_skew(self):
+        frame = bytearray(ipc.encode_frame(ipc.BYE, {}))
+        frame[1] += 1
+        with pytest.raises(ipc.IPCError, match="version skew"):
+            ipc.decode_frame(bytes(frame))
+
+    def test_unknown_kind(self):
+        frame = bytearray(ipc.encode_frame(ipc.BYE, {}))
+        frame[2] = 250
+        with pytest.raises(ipc.IPCError, match="kind"):
+            ipc.decode_frame(bytes(frame))
+
+    def test_flipped_payload_byte_fails_checksum(self):
+        frame = bytearray(ipc.encode_frame(ipc.HEARTBEAT, {"t": 1.5}))
+        frame[-3] ^= 0x10
+        with pytest.raises(ipc.IPCError, match="checksum"):
+            ipc.decode_frame(bytes(frame))
+
+    def test_non_object_payload_rejected(self):
+        # a frame whose body parses but is not a JSON object is as
+        # untrustworthy as garbage — build one by hand
+        import json
+        import struct
+        import zlib
+        body = json.dumps([1, 2, 3]).encode()
+        frame = struct.Struct("<BBBxI").pack(
+            0xD5, ipc.PROTOCOL_VERSION, 4, zlib.crc32(body)) + body
+        with pytest.raises(ipc.IPCError, match="object"):
+            ipc.decode_frame(frame)
+
+
+# ---------------------------------------------------------------------------
+# wire round trips (the replay-identity contract)
+# ---------------------------------------------------------------------------
+
+
+def _random_request(rng: random.Random, rid: int) -> S.RequestHandle:
+    req = S.Request(
+        codes=tuple(rng.randrange(1, 50)
+                    for _ in range(rng.randrange(1, 9))),
+        seed=rng.randrange(-2**31, 2**31),
+        sampling=S.SamplingParams(
+            temperature=rng.uniform(0.05, 3.0),
+            filter_thres=rng.uniform(0.0, 0.99),
+            top_p=rng.choice([0.0, rng.uniform(0.1, 1.0)])),
+        priority=rng.randrange(-3, 4),
+        deadline_s=rng.choice([None, rng.uniform(0.001, 1e4)]),
+        request_id=rid,
+        submit_t=rng.uniform(0, 1e6))
+    h = S.RequestHandle(req)
+    h.queue_seq = rng.randrange(0, 10**9)
+    return h
+
+
+class TestWireRoundTrip:
+    def test_request_handles_fuzzed(self):
+        """200 random handles through an encoded frame: every field
+        that feeds deterministic replay — codes, seed, every sampling
+        float, priority, queue_seq — comes back EXACTLY (floats ride
+        JSON repr, which round-trips bit-exact in Python)."""
+        rng = random.Random(0xDA11E)
+        now = 123.25
+        for i in range(200):
+            h = _random_request(rng, i)
+            frame = ipc.encode_frame(
+                ipc.ADMIT, {"requests": [h.to_wire(now)]})
+            _, payload = ipc.decode_frame(frame)
+            h2 = S.RequestHandle.from_wire(payload["requests"][0],
+                                           now=now)
+            r, r2 = h.request, h2.request
+            assert r2.codes == r.codes
+            assert r2.seed == r.seed
+            assert r2.sampling.temperature == r.sampling.temperature
+            assert r2.sampling.filter_thres == r.sampling.filter_thres
+            assert r2.sampling.top_p == r.sampling.top_p
+            assert r2.priority == r.priority
+            assert r2.request_id == r.request_id
+            assert h2.queue_seq == h.queue_seq
+
+    def test_deadline_ships_as_remaining_budget(self):
+        req = S.Request(codes=(1, 2), deadline_s=10.0, request_id=7,
+                        submit_t=100.0)
+        h = S.RequestHandle(req)
+        h.queue_seq = 3
+        wire = h.to_wire(now=104.0)         # 6s of budget left
+        assert wire["deadline_left_s"] == pytest.approx(6.0)
+        h2 = S.RequestHandle.from_wire(wire, now=50.0)
+        assert h2.request.deadline_t == pytest.approx(56.0)
+        # and a deadline already blown ships as zero, not negative
+        assert S.RequestHandle(req).to_wire(
+            now=1000.0)["deadline_left_s"] == 0.0
+
+    def test_results_every_status(self):
+        rng = random.Random(7)
+        cases = [
+            S.Result(status=S.OK, request_id=1,
+                     tokens=np.asarray(
+                         [rng.randrange(0, 512) for _ in range(48)],
+                         np.int32),
+                     text_tokens=np.asarray([3, 1, 4, 1, 5], np.int32),
+                     queued_s=0.125, decode_s=1.5, total_s=1.625),
+            S.Result(status=S.ERROR, request_id=2,
+                     reason="prefill failed: boom"),
+            S.Result(status=S.DEADLINE_EXCEEDED, request_id=3,
+                     reason="deadline_s=1 exceeded (queued)",
+                     queued_s=1.0, total_s=1.0),
+            S.Result(status=S.CANCELLED, request_id=4,
+                     reason="server shutdown"),
+            S.Result(status=S.REJECTED, request_id=5,
+                     reason="queue_full"),
+        ]
+        for res in cases:
+            _, payload = ipc.decode_frame(ipc.encode_frame(
+                ipc.HARVEST, {"results": [res.to_wire()], "snap": None}))
+            res2 = S.Result.from_wire(payload["results"][0])
+            assert res2.status == res.status
+            assert res2.request_id == res.request_id
+            assert res2.reason == res.reason
+            assert res2.queued_s == res.queued_s
+            assert res2.decode_s == res.decode_s
+            assert res2.total_s == res.total_s
+            if res.tokens is None:
+                assert res2.tokens is None
+            else:
+                np.testing.assert_array_equal(res2.tokens, res.tokens)
+                assert res2.tokens.dtype == np.int32
+                np.testing.assert_array_equal(res2.text_tokens,
+                                              res.text_tokens)
+
+    def test_unknown_status_rejected(self):
+        wire = S.Result(status=S.OK, request_id=1).to_wire()
+        wire["status"] = "mystery"
+        with pytest.raises(ValueError, match="status"):
+            S.Result.from_wire(wire)
+
+
+# ---------------------------------------------------------------------------
+# the client's poisoned-not-deadlocked contract (no process needed)
+# ---------------------------------------------------------------------------
+
+
+class _FakeConn:
+    """Stands in for the parent end of the pipe: scripted frames."""
+
+    def __init__(self, frames):
+        self.frames = list(frames)
+
+    def poll(self, timeout=0):
+        return bool(self.frames)
+
+    def recv_bytes(self):
+        if not self.frames:
+            raise EOFError
+        return self.frames.pop(0)
+
+    def send_bytes(self, data):
+        pass
+
+
+def _client_shell():
+    """A ChildEngineClient with the spawn bypassed: protocol-state unit
+    tests only need the dispatch machinery, not a live child."""
+    c = ipc.ChildEngineClient.__new__(ipc.ChildEngineClient)
+    c.clock = time.perf_counter
+    c.index = 0
+    c.num_slots, c.chunk_steps, c.kv = 2, 4, "dense"
+    c.on_done = None
+    c.ready = True
+    c.fenced = c.crashed = c.poisoned = c.bye = False
+    c.last_error = ""
+    c.shadow = {}
+    c.counter_state = {k: 0 for k in ipc.COUNTERS}
+    c.progress = {}
+    c.active = c.queued = c.chunks = c.rss_mb = 0
+    c.compiling = False
+    c.pages_free = -1
+    c.last_heartbeat = time.perf_counter()
+    c.stats_reply = None
+    from collections import deque
+    c.ipc_lag_s = deque(maxlen=100)
+    return c
+
+
+class TestClientPoisoning:
+    def test_garbage_frame_poisons_instead_of_deadlocking(self):
+        c = _client_shell()
+        c._conn = _FakeConn([b"\xde\xad garbage"])
+        t0 = time.perf_counter()
+        assert c.pump() is True
+        assert time.perf_counter() - t0 < 1.0      # returned, not hung
+        assert c.poisoned
+        assert "protocol error" in c.last_error
+
+    def test_malformed_snapshot_poisons(self):
+        frame = ipc.encode_frame(ipc.HEARTBEAT,
+                                 {"snap": {"counters": "nope"}})
+        c = _client_shell()
+        c._conn = _FakeConn([frame])
+        c.pump()
+        assert c.poisoned and "malformed snapshot" in c.last_error
+
+    def test_malformed_result_poisons(self):
+        frame = ipc.encode_frame(
+            ipc.HARVEST,
+            {"results": [{"id": 1, "status": 5}], "snap": None})
+        c = _client_shell()
+        c._conn = _FakeConn([frame])
+        c.pump()
+        assert c.poisoned and "malformed result" in c.last_error
+
+    def test_fenced_client_drops_frames(self):
+        """A zombie child's late result must never fulfil a handle the
+        failover already reclaimed — the client-side fence guard."""
+        req = S.Request(codes=(1,), request_id=9)
+        h = S.RequestHandle(req)
+        c = _client_shell()
+        c.shadow[9] = h
+        res = S.Result(status=S.OK, request_id=9,
+                       tokens=np.asarray([1, 2], np.int32))
+        frame = ipc.encode_frame(
+            ipc.HARVEST, {"results": [res.to_wire()], "snap": None})
+        c.fence()
+        c._conn = _FakeConn([frame])
+        assert c.pump() is False
+        assert not h.done()
+
+    def test_salvaged_results_fulfil_and_leave_shadow(self):
+        """The kill->salvage order: frames the child wrote before dying
+        fulfil their handles and are NOT part of the reclaim set."""
+        done_h = S.RequestHandle(S.Request(codes=(1,), request_id=1))
+        open_h = S.RequestHandle(S.Request(codes=(2,), request_id=2))
+        c = _client_shell()
+        c.shadow = {1: done_h, 2: open_h}
+        res = S.Result(status=S.OK, request_id=1,
+                       tokens=np.asarray([5], np.int32))
+        snap = {"counters": {k: (3 if k == "tokens_decoded" else 0)
+                             for k in ipc.COUNTERS},
+                "progress": {"2": 2}, "active_slots": 1, "queued": 0,
+                "chunks": 1, "compiling": False, "rss_mb": 10,
+                "t": time.perf_counter(), "pages_free": -1}
+        c._conn = _FakeConn([ipc.encode_frame(
+            ipc.HARVEST, {"results": [res.to_wire()], "snap": snap})])
+        c.salvage()
+        c.fence()
+        assert done_h.done() and done_h.result(0).status == S.OK
+        reclaimed = c.reclaim()
+        assert reclaimed == [open_h]
+        # retire math un-credits the reclaimed request's 2-token prefix
+        retired = c.retire_counters(reclaimed)
+        assert retired["tokens_decoded"] == 1
+
+
+# ---------------------------------------------------------------------------
+# worker: parent death -> child exit (no leaked interpreters)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_bundle():
+    import jax
+
+    from dalle_pytorch_tpu.models import dalle as D
+    from dalle_pytorch_tpu.models import vae as V
+    vcfg = V.VAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                       num_layers=2, hidden_dim=8)
+    cfg = D.DALLEConfig(dim=16, depth=2, vae=vcfg, num_text_tokens=50,
+                        text_seq_len=8, heads=2, dim_head=8)
+    key = jax.random.PRNGKey(0)
+    vae_params = V.vae_init(jax.random.fold_in(key, 1), vcfg)
+    params = jax.tree.map(np.asarray, D.dalle_init(key, cfg, vae_params))
+    return params, cfg
+
+
+class TestWorkerLifecycle:
+    def test_worker_exits_when_parent_end_closes(self, tiny_bundle):
+        """The no-leak contract: a worker whose parent vanishes (both
+        parent pipe handles gone — what a parent SIGKILL leaves behind)
+        must notice EOF and exit on its own, not idle forever holding a
+        device. Exit code 3 is the worker's parent-gone path."""
+        from dalle_pytorch_tpu.serve import worker as worker_mod
+        params, cfg = tiny_bundle
+        spec = {"index": 0, "params": params, "cfg": cfg,
+                "engine_kwargs": {"num_slots": 2, "chunk_steps": 4},
+                "device_index": 0, "place": False,
+                "heartbeat_interval_s": 0.05, "rss_limit_mb": 0,
+                "faults": None, "idle_sleep_s": 0.002}
+        ctx = mp.get_context("spawn")
+        parent_end, child_end = ctx.Pipe(duplex=True)
+        proc = ctx.Process(target=worker_mod.worker_main,
+                           args=(spec, child_end), daemon=True)
+        proc.start()
+        child_end.close()
+        # wait for READY — the worker is fully up, in its idle loop
+        deadline = time.perf_counter() + 120
+        ready = False
+        while time.perf_counter() < deadline:
+            if parent_end.poll(0.1):
+                kind, _ = ipc.decode_frame(parent_end.recv_bytes())
+                if kind == ipc.READY:
+                    ready = True
+                    break
+        assert ready, "worker never came up"
+        parent_end.close()              # the parent "dies"
+        proc.join(30)
+        assert proc.exitcode == 3, \
+            f"worker leaked (exitcode={proc.exitcode})"

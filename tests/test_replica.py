@@ -355,6 +355,246 @@ class TestDrain:
         assert h.result(timeout=10).status == OK
 
 
+class TestProcessIsolation:
+    """isolation='process': replicas are spawned child processes behind
+    the typed IPC layer (serve/ipc.py + serve/worker.py). Base
+    coverage: the set serves token-exact through the pipe, the operator
+    surface reports child PIDs/RSS/restarts, and drain/undrain cycles a
+    child process. Hard-kill failover lives in TestProcessHardKill."""
+
+    def test_process_set_serves_token_exact_and_drain_cycles(
+            self, bundle):
+        params, vae_params = bundle
+        queue = RequestQueue(max_depth=16)
+        rs = ReplicaSet(params, CFG, queue, replicas=2, num_slots=2,
+                        chunk_steps=4, isolation="process",
+                        bringup_policy=FAST_BRINGUP)
+        try:
+            handles = [queue.submit(r) for r in REQS[:4]]
+            rs.run_until_idle(max_steps=500_000)
+            assert_all_token_exact(params, vae_params, handles, REQS[:4])
+            stats = rs.stats()
+            assert stats["isolation"] == "process"
+            assert stats["completed"] == 4
+            assert stats["failovers"] == 0
+            # distinct-delivered-token accounting across the pipe:
+            # counters mirror the children's frames exactly
+            assert stats["tokens_decoded"] == sum(
+                CFG.seq_len - len(r.codes) for r in REQS[:4])
+            assert rs.decode_compiles_per_replica() == [1, 1]
+            pids = [p["pid"] for p in stats["per_replica"]]
+            assert len(set(pids)) == 2
+            assert all(isinstance(p, int) and p > 0 for p in pids)
+            assert all(p["rss_mb"] > 0 for p in stats["per_replica"])
+            # operator drain kills the child; undrain spawns a fresh one
+            old_pid = pids[0]
+            rs.drain_replica(0)
+            assert rs.replicas[0].state == DRAINED
+            assert rs.undrain_replica(0)
+            h = queue.submit(REQS[4])
+            rs.run_until_idle(max_steps=500_000)
+            assert h.result(timeout=10).status == OK
+            new_pid = rs.replicas[0].engine.pid
+            assert new_pid != old_pid, "undrain must be a fresh process"
+        finally:
+            rs.close()
+
+    def test_process_server_end_to_end_health_and_stats(self, bundle):
+        """The full threaded server over process replicas: /healthz
+        carries the supervised-child fields (PID, restart count, last
+        exit, child RSS) and 503 only when all replicas are dead."""
+        params, vae_params = bundle
+        from dalle_pytorch_tpu.serve.server import InferenceServer
+        with pytest.raises(ValueError, match="replicas"):
+            InferenceServer(params, vae_params, CFG, replicas=1,
+                            isolation="process", decode_images=False)
+        server = InferenceServer(params, vae_params, CFG, num_slots=2,
+                                 queue_depth=16, replicas=2,
+                                 isolation="process",
+                                 decode_images=False).start()
+        try:
+            res = server.generate(REQS[0].codes, seed=REQS[0].seed,
+                                  timeout=120)
+            assert res.status == OK
+            np.testing.assert_array_equal(
+                np.asarray(res.tokens),
+                reference_tokens(params, vae_params, REQS[0]))
+            health = server.health()
+            assert health["ok"] is True
+            assert len(health["replicas"]) == 2
+            for rep in health["replicas"]:
+                assert rep["alive"]
+                assert rep["pid"] > 0
+                assert rep["restarts"] == 0
+                assert rep["rss_mb"] > 0
+            stats = server.stats()
+            assert stats["isolation"] == "process"
+            assert stats["completed"] == 1
+        finally:
+            server.close()
+
+
+class TestProcessHardKill:
+    """THE acceptance criterion of the process-isolation PR: a child
+    replica killed for real — SIGKILL, SIGSEGV, a crash, an OOM kill,
+    or a corrupted pipe — mid-decode loses ZERO requests; everything it
+    held replays byte-identically on the survivor (reclaimed from the
+    parent's shadow bookkeeping, never from the corpse), aggregate
+    counters keep counting distinct delivered tokens, and the dead
+    replica rejoins routing through the circuit-breaker backoff."""
+
+    pytestmark = pytest.mark.faults
+
+    def _run_kill(self, bundle, plan_kwargs, expect_exit):
+        params, vae_params = bundle
+        queue = RequestQueue(max_depth=16)
+        with faults.injected(fault_replica=1, **plan_kwargs):
+            # construct INSIDE the plan: hard-fault plans cross the
+            # process boundary at spawn (faults.child_plan_for), once
+            # per activation, so the restarted child comes up clean
+            rs = ReplicaSet(params, CFG, queue, replicas=2, num_slots=2,
+                            chunk_steps=4, isolation="process",
+                            bringup_policy=FAST_BRINGUP)
+            try:
+                handles = [queue.submit(r) for r in REQS]
+                rs.run_until_idle(max_steps=500_000)
+                assert rs.failovers == 1
+                assert rs.reclaimed >= 1, "the kill stranded no work?"
+                assert_all_token_exact(params, vae_params, handles, REQS)
+                stats = rs.stats()
+                assert stats["completed"] == len(REQS)
+                assert stats["tokens_decoded"] == sum(
+                    CFG.seq_len - len(r.codes) for r in REQS), \
+                    "distinct-token accounting broke across the kill"
+                r1 = rs.replicas[1]
+                assert expect_exit in r1.last_exit, \
+                    (r1.last_exit, expect_exit)
+                # rejoined routing after the circuit-breaker backoff
+                assert r1.bringups >= 2
+                assert r1.state == RUNNING
+                assert rs.alive()
+            finally:
+                rs.close()
+
+    def test_sigkill_mid_decode_zero_loss_token_exact(self, bundle):
+        """kill -9 of a child replica mid-decode: the headline. The
+        child dies with no goodbye; the parent decodes the exit signal,
+        salvages the pipe, replays the shadow."""
+        self._run_kill(bundle, {"replica_sigkill_at_chunk": 2},
+                       expect_exit="SIGKILL")
+
+    def test_segv_mid_decode_zero_loss_token_exact(self, bundle):
+        """SIGSEGV — the XLA-bug shape of death — decodes as its own
+        signal and fails over identically."""
+        self._run_kill(bundle, {"replica_segv_at_chunk": 2},
+                       expect_exit="SIGSEGV")
+
+    def test_child_crash_frame_zero_loss_token_exact(self, bundle):
+        """A Python-level crash in the child ships a CRASH frame before
+        exit 1 — the soft half of the catalog, process-drivable."""
+        params, vae_params = bundle
+        queue = RequestQueue(max_depth=16)
+        with faults.injected(fault_replica=1, replica_crash_at_chunk=2):
+            rs = ReplicaSet(params, CFG, queue, replicas=2, num_slots=2,
+                            chunk_steps=4, isolation="process",
+                            bringup_policy=FAST_BRINGUP)
+            try:
+                handles = [queue.submit(r) for r in REQS[:4]]
+                rs.run_until_idle(max_steps=500_000)
+                assert rs.failovers == 1
+                assert_all_token_exact(params, vae_params, handles,
+                                       REQS[:4])
+            finally:
+                rs.close()
+
+    def test_oom_killed_child_fenced_and_replayed(self, bundle):
+        """The child-side RSS limit: the injected OOM allocates real
+        memory until the worker's watchdog crosses child_rss_limit_mb
+        and dies with exit 137 (the container OOM-kill convention) —
+        abruptly, no goodbye frame — and the failover replays its work
+        token-exact."""
+        params, vae_params = bundle
+        queue = RequestQueue(max_depth=16)
+        with faults.injected(fault_replica=1, replica_oom_at_chunk=1):
+            rs = ReplicaSet(params, CFG, queue, replicas=2, num_slots=2,
+                            chunk_steps=4, isolation="process",
+                            child_rss_limit_mb=1408,
+                            bringup_policy=FAST_BRINGUP)
+            try:
+                handles = [queue.submit(r) for r in REQS[:4]]
+                rs.run_until_idle(max_steps=500_000)
+                assert rs.failovers == 1
+                assert "oom" in rs.replicas[1].last_exit
+                assert_all_token_exact(params, vae_params, handles,
+                                       REQS[:4])
+            finally:
+                rs.close()
+
+    def test_garbage_frame_fences_not_deadlocks(self, bundle):
+        """A child that corrupts its stream (injected garbage frame) is
+        FENCED on the protocol error — hard-killed, salvaged, replayed
+        — rather than deadlocking the parent or mis-parsing the lie."""
+        params, vae_params = bundle
+        events = []
+
+        class Sink:
+            def event(self, **rec):
+                events.append(rec)
+
+        queue = RequestQueue(max_depth=16)
+        with faults.injected(fault_replica=1,
+                             replica_garbage_frame_at_chunk=1):
+            rs = ReplicaSet(params, CFG, queue, replicas=2, num_slots=2,
+                            chunk_steps=4, isolation="process",
+                            metrics=Sink(), bringup_policy=FAST_BRINGUP)
+            try:
+                handles = [queue.submit(r) for r in REQS[:4]]
+                rs.run_until_idle(max_steps=500_000)
+                assert rs.failovers == 1
+                fenced = [e for e in events
+                          if e.get("kind") == "serve_replica_fenced"]
+                assert fenced and "protocol error" in \
+                    fenced[0]["reason"], fenced
+                assert_all_token_exact(params, vae_params, handles,
+                                       REQS[:4])
+            finally:
+                rs.close()
+
+    def test_hung_child_hard_killed_within_heartbeat_deadline(
+            self, bundle):
+        """A child that is alive but silent (injected 20s stall where a
+        wedged device sync would sit) is hard-killed off the missed-
+        frame deadline — the hang detection working over the pipe, with
+        known compiles exempted via the compiling-heartbeat — and its
+        work replays long before the stall would have cleared."""
+        params, vae_params = bundle
+        queue = RequestQueue(max_depth=16)
+        hang_s = 20.0
+        with faults.injected(fault_replica=1, replica_hang_at_chunk=1,
+                             replica_hang_s=hang_s):
+            rs = ReplicaSet(params, CFG, queue, replicas=2, num_slots=2,
+                            chunk_steps=4, isolation="process",
+                            heartbeat_s=0.5,
+                            bringup_policy=FAST_BRINGUP)
+            try:
+                handles = [queue.submit(r) for r in REQS[:4]]
+                t0 = time.perf_counter()
+                rs.run_until_idle(max_steps=500_000)
+                assert rs.failovers == 1
+                assert time.perf_counter() - t0 < hang_s, \
+                    "completion waited out the hang instead of fencing"
+                # supervisor-initiated kill is labelled as such (and
+                # names the deadline that expired), never dressed up
+                # as an OS-delivered SIGKILL
+                assert "hard-killed by supervisor" in \
+                    rs.replicas[1].last_exit
+                assert "heartbeat" in rs.replicas[1].last_exit
+                assert_all_token_exact(params, vae_params, handles,
+                                       REQS[:4])
+            finally:
+                rs.close()
+
+
 class TestRoutingAndStats:
     def test_burst_routes_least_loaded_across_replicas(self, bundle):
         """A burst wider than one replica's slots spreads: both
