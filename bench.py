@@ -1368,6 +1368,100 @@ def _serve_kv_budget_compare(params, cfg, *, num_slots, page_size,
     return out
 
 
+def _serve_paged_attn_compare(params, cfg, *, num_slots, page_size,
+                              chunk_steps=8):
+    """Gather vs kernel over the same paged pool and burst — the number
+    the ragged paged-attention kernel exists for: per-token KV read
+    traffic down, so ms/token down. Both legs run the identical
+    fully-provisioned fused-K paged engine; each leg records measured
+    ms/token (warmed, compile excluded) plus the analytic KV
+    read-bytes-per-token model
+    (``ops.paged_attention.modeled_kv_read_bytes_per_token`` — the
+    gather leg reads the full ``seq_len`` view every step, the kernel
+    leg only the live pages; HBM counters are not host-observable, so
+    bytes are modeled, time is measured). The kernel-beats-gather
+    ms/token assertion fires on REAL TPU only: on CPU the kernel runs
+    under the Pallas interpreter, whose emulation overhead is not the
+    hardware's — there the record is report-only (``asserted``:false),
+    which is what CI's serve-perf kernel leg runs. Leg-to-leg token
+    agreement is recorded (``token_mismatches``); the byte-identical
+    contract itself is pinned in f32 by tests/test_paged_attention.py
+    (bench runs bf16 params, where the kernel's f32 accumulation is
+    deliberately not bit-matched to the gather's bf16 scores)."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.ops import paged_attention as PA
+    from dalle_pytorch_tpu.serve import Request, RequestQueue, \
+        SamplingParams
+    from dalle_pytorch_tpu.serve.engine import Engine
+
+    import jax
+    import jax.numpy as jnp
+
+    prompt_len = min(4, cfg.text_seq_len)
+    n_req = 2 * num_slots
+    tokens_per_req = cfg.seq_len - prompt_len
+    on_tpu = jax.default_backend() == "tpu"
+    tcfg = cfg.transformer
+    itemsize = jnp.dtype(params["text_emb"]["w"].dtype).itemsize
+    out = {"page_size": page_size, "chunk_steps": chunk_steps,
+           "requests": n_req, "asserted": on_tpu}
+    toks = {}
+    for impl in ("gather", "kernel"):
+        queue = RequestQueue(max_depth=2 * n_req + 4)
+        engine = Engine(params, cfg, queue, num_slots=num_slots,
+                        chunk_steps=chunk_steps, kv="paged",
+                        page_size=page_size, paged_attn=impl)
+        # warm the decode program + prefill bucket outside the timing
+        h = queue.submit(Request(codes=(1,) * prompt_len, seed=0,
+                                 sampling=SamplingParams()))
+        engine.run_until_idle()
+        h.result(timeout=120)
+        t0 = time.perf_counter()
+        handles = [queue.submit(Request(
+            codes=(1 + i % 7,) * prompt_len, seed=i,
+            sampling=SamplingParams())) for i in range(n_req)]
+        engine.run_until_idle()
+        wall = time.perf_counter() - t0
+        results = [h.result(timeout=120) for h in handles]
+        ok = sum(r.status == "ok" for r in results)
+        if ok != n_req:
+            raise AssertionError(
+                f"paged_attn={impl}: only {ok}/{n_req} completed")
+        snap = engine.stats()
+        if snap["decode_compiles"] != 1:
+            raise AssertionError(
+                f"paged_attn={impl}: decode compiled "
+                f"{snap['decode_compiles']} times — the kernel must live "
+                f"inside the ONE fused decode program")
+        toks[impl] = [np.asarray(r.tokens) for r in results]
+        out[impl] = {
+            "wall_s": round(wall, 4),
+            "ms_per_token": round(
+                1e3 * wall / (n_req * tokens_per_req), 4),
+            "read_bytes_per_token": int(
+                PA.modeled_kv_read_bytes_per_token(
+                    depth=tcfg.depth, heads=tcfg.heads,
+                    dim_head=tcfg.dim_head, total_len=cfg.seq_len,
+                    page_size=page_size, prompt_len=prompt_len,
+                    itemsize=itemsize, impl=impl)),
+            "decode_compiles": snap["decode_compiles"],
+        }
+    out["read_bytes_ratio"] = round(
+        out["gather"]["read_bytes_per_token"]
+        / max(out["kernel"]["read_bytes_per_token"], 1), 2)
+    out["token_mismatches"] = int(sum(
+        not np.array_equal(a, b)
+        for a, b in zip(toks["gather"], toks["kernel"])))
+    if on_tpu and out["kernel"]["ms_per_token"] \
+            >= out["gather"]["ms_per_token"]:
+        raise AssertionError(
+            f"ragged paged-attention kernel did not beat the dense-view "
+            f"gather on hardware: {out['kernel']['ms_per_token']} vs "
+            f"{out['gather']['ms_per_token']} ms/token")
+    return out
+
+
 def _serve_replica_compare(params, cfg, *, replicas, num_slots, n_req,
                            kv, page_size, chunk_steps=8):
     """The replica-set headline: N supervised engines behind one queue
@@ -1670,6 +1764,11 @@ def bench_serve(args):
     prompt_len = min(4, cfg.text_seq_len)
     errors = []
     kv = args.serve_kv
+    paged_attn = args.serve_paged_attn
+    if paged_attn == "kernel" and kv != "paged":
+        raise ValueError("--serve_paged_attn kernel requires "
+                         "--serve_kv paged (the kernel reads the page "
+                         "pool through block tables)")
     # default page size: divide the tiny seq exactly so the budget
     # comparison compares equal KV bytes, 16 rows on the real config
     page_size = args.serve_page_size or (8 if args.tiny else 16)
@@ -1682,10 +1781,13 @@ def bench_serve(args):
         queue = RequestQueue(max_depth=2 * num_slots)
         engine = Engine(params, cfg, queue, num_slots=num_slots,
                         chunk_steps=k, kv=kv,
-                        page_size=page_size if kv == "paged" else 0)
+                        page_size=page_size if kv == "paged" else 0,
+                        paged_attn=paged_attn if kv == "paged"
+                        else "gather")
         _progress(f"serve: K={k} compiling bucketed prefill + fused "
-                  f"{k}-step decode ({num_slots} slots, kv={kv}, "
-                  f"seq {cfg.seq_len})")
+                  f"{k}-step decode ({num_slots} slots, kv={kv}"
+                  + (f"/{paged_attn}" if kv == "paged" else "")
+                  + f", seq {cfg.seq_len})")
         with guards.compile_count(lambda: engine.decode_traces, expect=1,
                                   label=f"serve decode program (K={k})",
                                   raise_on_violation=False) as decode_guard:
@@ -1736,6 +1838,23 @@ def bench_serve(args):
         kv_compare = {"error": f"{type(e).__name__}: {e}"}
         errors.append(str(e))
 
+    _progress("serve: paged-attention gather-vs-kernel comparison")
+    try:
+        from dalle_pytorch_tpu.serve import kv_pool as _kv_pool
+        try:
+            _kv_pool.validate_page_size(page_size)
+            compare_ps = page_size
+        except _kv_pool.PageSizeError:
+            # a gather-only page size (e.g. 4) can't feed the kernel —
+            # compare at the kernel's tile minimum instead of erroring
+            compare_ps = _kv_pool.KERNEL_MIN_PAGE_SIZE
+        pa_compare = _serve_paged_attn_compare(
+            params, cfg, num_slots=num_slots, page_size=compare_ps)
+    except Exception as e:  # noqa: BLE001 — same structured-error
+        # contract: the serve-perf CI smoke greps for it
+        pa_compare = {"error": f"{type(e).__name__}: {e}"}
+        errors.append(str(e))
+
     replica_compare = None
     if args.replicas > 1:
         _progress(f"serve: {args.replicas}-replica scaling + "
@@ -1775,8 +1894,10 @@ def bench_serve(args):
         "vs_baseline": None,
         "num_slots": num_slots, "seq_len": cfg.seq_len,
         "prompt_len": prompt_len, "chunk_sweep": chunk_sweep,
-        "kv": kv, "k_sweep": k_sweep, "transfer_clean": True,
+        "kv": kv, "paged_attn": paged_attn,
+        "k_sweep": k_sweep, "transfer_clean": True,
         "kv_budget_compare": kv_compare,
+        "paged_attn_compare": pa_compare,
         "devices": len(jax.devices()), "backend": jax.default_backend(),
     }
     if replica_compare is not None:
@@ -1882,6 +2003,15 @@ def main():
                          "(the dense-vs-paged budget comparison always "
                          "runs; CI's serve-perf matrix runs one leg per "
                          "layout)")
+    ap.add_argument("--serve_paged_attn", default="gather",
+                    choices=["gather", "kernel"],
+                    help="bench_serve: paged K/V read impl for the "
+                         "K-sweep engine (kernel = the Pallas ragged "
+                         "paged-attention kernel; requires --serve_kv "
+                         "paged). The gather-vs-kernel ms/token + "
+                         "read-bytes comparison (paged_attn_compare) "
+                         "always runs — asserted on real TPU, "
+                         "report-only under interpret mode on CPU")
     ap.add_argument("--serve_page_size", type=int, default=0,
                     help="bench_serve: KV page size for paged engines "
                          "(0 = 8 rows under --tiny so pages divide the "

@@ -75,6 +75,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rows per KV page (paged mode; 0 = default 16). "
                         "Smaller pages waste fewer rows per request but "
                         "widen the block tables")
+    p.add_argument("--paged_attn", choices=("gather", "kernel"),
+                   default="gather",
+                   help="paged K/V read implementation: 'gather' "
+                        "materializes a dense view through the block "
+                        "tables every step (the parity oracle); "
+                        "'kernel' runs the Pallas ragged paged-"
+                        "attention kernel, which walks the block "
+                        "tables in place and moves only each "
+                        "request's LIVE pages HBM->VMEM — the "
+                        "per-token read-traffic lever (docs/SERVING.md "
+                        "'Paged attention kernel'). Requires --kv "
+                        "paged and a page_size that is a multiple of "
+                        "8 (the kernel's VMEM tile)")
     p.add_argument("--num_pages", type=int, default=0,
                    help="physical pages in the pool incl. the reserved "
                         "trash page (paged mode; 0 = fully provisioned: "
@@ -194,6 +207,7 @@ def main(argv=None):
         prefill_buckets=buckets,
         quantize_cache=args.quantize == "int8_kv",
         kv=args.kv, page_size=args.page_size, num_pages=args.num_pages,
+        paged_attn=args.paged_attn,
         replicas=args.replicas, heartbeat_s=args.heartbeat_s,
         isolation=args.isolation,
         child_rss_limit_mb=args.child_rss_limit_mb,
@@ -201,9 +215,11 @@ def main(argv=None):
         log_every=args.log_every, encode=vocab.encode,
         init_deadline_s=args.init_deadline_s,
         init_retries=args.init_retries).start()
+    kv_desc = args.kv if args.kv == "dense" \
+        else f"{args.kv}/{args.paged_attn}"
     say(f"serving {dalle_path} on http://{args.host}:{args.port} "
         f"({args.replicas} {args.isolation} replica(s) x "
-        f"{args.num_slots} slots, K={args.chunk_steps}, kv={args.kv}, "
+        f"{args.num_slots} slots, K={args.chunk_steps}, kv={kv_desc}, "
         f"queue {args.queue_depth})")
     serve_http(server, args.host, args.port)
 

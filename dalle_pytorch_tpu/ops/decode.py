@@ -295,7 +295,8 @@ def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,
 
 
 def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
-                      *, cfg, key_mask: Array
+                      *, cfg, key_mask: Array, attn_impl: str = "gather",
+                      block_tables: Optional[Array] = None
                       ) -> Tuple[Array, Array, Array]:
     """The read half of ``decode_step``: attention over the cached rows
     plus self, WITHOUT the cache write-back. Returns (h_out (b, dim),
@@ -303,12 +304,38 @@ def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
     the dense slot cache (``_store_rows``) and the paged page pool
     (``_store_rows_paged``) — share one definition of the math and can
     never diverge on what a step computes (``decode_step_paged`` is the
-    paged writer)."""
+    paged writer).
+
+    ``attn_impl`` is the paged-read seam: ``'gather'`` (default) reads
+    ``cache`` as a dense per-slot view — either the real dense slot
+    cache or ``paged_view``'s block-table gather — through one einsum
+    softmax; ``'kernel'`` reads ``cache`` as the raw PAGE POOL
+    ``(depth, P, heads, page_size, dh)`` and consumes ``block_tables``
+    in place via the Pallas ragged paged-attention kernel
+    (``ops.paged_attention``), which fetches only each slot's mapped
+    live pages into VMEM and returns online-softmax partials that the
+    self-logit merge below completes. The gather path stays the parity
+    ORACLE: kernel output must be allclose to it under the same masks
+    (rows >= pos dead, trash-page rows never attended), and emitted
+    tokens byte-identical under greedy/seeded sampling
+    (tests/test_paged_attention.py)."""
     from dalle_pytorch_tpu.ops import transformer as T
-    depth, b, heads, total_len, dh = cache["k"].shape
+    b = x_tok.shape[0]
+    total_len = key_mask.shape[1]
     sparse_flags = jnp.asarray(cfg.sparse_pattern)
     any_sparse = any(cfg.sparse_pattern)
     per_slot = getattr(pos, "ndim", 0) == 1
+    if attn_impl not in ("gather", "kernel"):
+        raise ValueError(f"attn_impl must be 'gather' or 'kernel', "
+                         f"got {attn_impl!r}")
+    kernel_mode = attn_impl == "kernel"
+    if kernel_mode:
+        if not per_slot:
+            raise ValueError("attn_impl='kernel' requires per-slot (b,) "
+                             "positions (the serving decode shape)")
+        if block_tables is None:
+            raise ValueError("attn_impl='kernel' requires block_tables")
+        from dalle_pytorch_tpu.ops import paged_attention as PA
 
     j = jnp.arange(total_len)
     # strictly-before rows; self added as the concatenated extra logit
@@ -335,6 +362,27 @@ def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
         q, k, v = attn_ops.qkv_project(p, hn, cfg.heads)      # (b, h, 1, dh)
         allowed = jnp.where(is_sparse, sparse_allowed, dense_allowed) \
             if any_sparse else dense_allowed
+        if kernel_mode:
+            # ck/cv are the raw page pool for this layer; the kernel
+            # walks the block tables in place and returns unnormalized
+            # (acc, m, l) over the cached rows. Folding in the self
+            # logit with the two-estimate softmax merge reproduces
+            # softmax(concat([scores, self])) exactly up to summation
+            # order — the gather oracle's computation.
+            acc, m, l = PA.paged_decode_attention(
+                q[:, :, 0, :], ck, cv, block_tables, pos, allowed,
+                scale=cfg.scale, k_scales=ksc, v_scales=vsc)
+            self_s = (jnp.einsum("bhqd,bhqd->bhq", q, k)[:, :, 0]
+                      .astype(jnp.float32) * cfg.scale)        # (b, h)
+            m_t = jnp.maximum(m, self_s)       # self is finite: m_t too
+            alpha = jnp.exp(m - m_t)
+            w_self = jnp.exp(self_s - m_t)
+            denom = l * alpha + w_self         # >= w_self > 0: no 0-div
+            out = (acc * alpha[..., None]
+                   + w_self[..., None] * v[:, :, 0, :]
+                   .astype(jnp.float32)) / denom[..., None]
+            out = out.astype(q.dtype)[:, :, None, :]
+            return attn_ops.output_tail(p, out), k, v
         # int8 cache: XLA reads int8 rows from HBM, upcasts in registers,
         # and the per-row scales apply OUTSIDE the contractions (along j),
         # so no dequantized copy materializes — same trick as ops/quant
@@ -404,9 +452,11 @@ def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
 # — row j of the view is position j, making paged-vs-dense token equality
 # hold by construction. The gather materializes the per-step read (same
 # bytes a dense step reads); the HBM win is *residency* — the pool can be
-# far smaller than num_slots x total_len. A Pallas ragged-paged-attention
-# kernel that consumes the block table directly (never materializing the
-# view) is the chip-side follow-up; this layout is what it would consume.
+# far smaller than num_slots x total_len. The chip-side fix for the READ
+# traffic is ``attn_impl='kernel'``: the Pallas ragged paged-attention
+# kernel (ops/paged_attention.py) consumes the block tables in place —
+# only each slot's live pages move HBM->VMEM — with this gather kept as
+# the parity oracle the kernel is tested against.
 
 
 def paged_view(pool: dict, block_tables: Array, total_len: int) -> dict:
@@ -417,7 +467,19 @@ def paged_view(pool: dict, block_tables: Array, total_len: int) -> dict:
     Unmapped table entries point at the reserved trash page 0; their rows
     are never attended (causality masks every row >= the slot's pos,
     and the allocator maps pages ahead of pos). Scales gather the same
-    way for the int8 pool (kv_pool.init_page_pool)."""
+    way for the int8 pool (kv_pool.init_page_pool).
+
+    The gather width is TRIMMED to ``ceil(total_len / page_size)``
+    table columns up front: a caller handing a wider table (block
+    tables are sized for the pool's max sequence, not this view's)
+    must not drag K/V — or the int8 pool's k_scale/v_scale pages —
+    for wholly-unmapped logical pages beyond ``total_len`` through the
+    gather just to slice them off; rows and scales share the one trim
+    so their shape contract ((..., total_len[, dh])) cannot drift
+    (tests/test_paged_attention.py pins it)."""
+    page_size = pool["k"].shape[3]
+    need = -(-total_len // page_size)             # pages_for(total_len)
+    block_tables = block_tables[:, :need]
 
     def rows(buf):
         g = jnp.take(buf, block_tables, axis=1)   # (d, b, mp, heads, ps, dh)
@@ -478,24 +540,33 @@ def _store_rows_paged(pool: dict, ks: Array, vs: Array, pos: Array,
 
 def decode_step_paged(params: dict, x_tok: Array, pos: Array, pool: dict,
                       block_tables: Array, *, cfg, key_mask: Array,
-                      total_len: int, active: Array
-                      ) -> Tuple[Array, dict]:
-    """``decode_step`` against the paged pool: gather the dense view
-    through the block tables, run the one shared step math, scatter the
-    new row back into its page. ``active`` routes dead slots' writes to
-    the trash page (see ``_store_rows_paged``). Token-exact with the
-    dense step because the math between gather and scatter IS
-    ``_decode_step_math``."""
-    view = paged_view(pool, block_tables, total_len)
-    h_out, ks, vs = _decode_step_math(params, x_tok, pos, view, cfg=cfg,
-                                      key_mask=key_mask)
+                      total_len: int, active: Array,
+                      attn_impl: str = "gather") -> Tuple[Array, dict]:
+    """``decode_step`` against the paged pool. ``attn_impl='gather'``
+    (default, the parity oracle) gathers the dense view through the
+    block tables and runs the one shared step math — token-exact with
+    the dense step by construction. ``attn_impl='kernel'`` skips the
+    view entirely: the Pallas ragged paged-attention kernel consumes
+    the block tables in place (only each slot's live pages move), and
+    the same ``_decode_step_math`` body merges its partials, so the
+    two impls share every line outside the K/V read itself. Either
+    way the new row scatters back into its page; ``active`` routes
+    dead slots' writes to the trash page (``_store_rows_paged``)."""
+    if attn_impl == "kernel":
+        h_out, ks, vs = _decode_step_math(
+            params, x_tok, pos, pool, cfg=cfg, key_mask=key_mask,
+            attn_impl="kernel", block_tables=block_tables)
+    else:
+        view = paged_view(pool, block_tables, total_len)
+        h_out, ks, vs = _decode_step_math(params, x_tok, pos, view,
+                                          cfg=cfg, key_mask=key_mask)
     return h_out, _store_rows_paged(pool, ks, vs, pos, block_tables, active)
 
 
 def decode_loop_paged(params: dict, cur_tok: Array, pos: Array,
                       active: Array, pool: dict, block_tables: Array, *,
                       cfg, key_mask: Array, total_len: int, steps: int,
-                      embed_fn, sample_fn
+                      embed_fn, sample_fn, attn_impl: str = "gather"
                       ) -> Tuple[Array, Array, Array, dict, Array]:
     """``decode_loop`` over the paged pool: the same one-compile fused
     K-step scan and emit-ring contract, with (cur_tok, pos, active, pool)
@@ -504,7 +575,9 @@ def decode_loop_paged(params: dict, cur_tok: Array, pos: Array,
     steps could write, so a mid-chunk page-boundary crossing finds its
     page already mapped). Dead slots park at (tok 0, pos 0) writing the
     trash page; emit semantics (-1 sentinel) are identical to the dense
-    loop."""
+    loop. ``attn_impl`` selects the per-step K/V read: the dense-view
+    gather (oracle) or the in-place Pallas kernel — both run inside the
+    SAME fused scan, so the one-compile/emit-ring regime is unchanged."""
 
     def one_step(carry, _):
         cur_tok, pos, act, pool = carry
@@ -512,7 +585,8 @@ def decode_loop_paged(params: dict, cur_tok: Array, pos: Array,
         x = embed_fn(cur_tok, pos)
         h, pool = decode_step_paged(params, x, pos, pool, block_tables,
                                     cfg=cfg, key_mask=key_mask,
-                                    total_len=total_len, active=act)
+                                    total_len=total_len, active=act,
+                                    attn_impl=attn_impl)
         nxt = sample_fn(h, pos + 1)
         pos = pos + 1
         act = act & (pos < total_len)

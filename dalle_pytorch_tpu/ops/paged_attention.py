@@ -1,0 +1,318 @@
+"""Ragged paged-attention decode — Pallas TPU kernel over the page pool.
+
+The paged KV layout (serve/kv_pool.py) won HBM *residency*: the pool is
+far smaller than ``num_slots × seq_len``. It did not win read traffic —
+every decode step still materializes a dense ``[slots, seq]`` K/V view
+through ``ops.decode.paged_view``'s block-table gather, so the bytes
+moved per token are the dense layout's plus the gather's index traffic.
+This module is the chip-side fix (PAPERS.md *Ragged Paged Attention*):
+a kernel that consumes the block tables IN PLACE.
+
+Shape of the computation (one ``pl.pallas_call`` per layer, inside the
+engine's fused K-step decode scan):
+
+  * grid = ``(slots, heads // head_tile)`` — one program per
+    (slot, head-tile), the ragged-paged-attention program shape;
+  * each program reads its slot's ``pos`` and block-table row from SMEM
+    and walks ``ceil(pos / page_size)`` pages — RAGGED per-slot trip
+    counts: a slot 10 tokens into a 1280-token sequence touches 1 page,
+    not 80, and a dead slot parked at pos 0 touches none (the reserved
+    trash page is never read);
+  * pages live in HBM (``memory_space=ANY``) and are staged into VMEM
+    scratch by explicit double-buffered async copies — page ``p+1``'s
+    DMA is in flight while page ``p`` is on the MXU, the guide's
+    canonical pipeline (the pool never transits VMEM whole, which is
+    what the dense-view gather effectively forces);
+  * attention is the online-softmax recurrence over pages
+    (flash-attention's m/l bookkeeping), returning UNNORMALIZED
+    partials ``(acc, m, l)`` over the cached rows only — the caller
+    (``ops.decode._decode_step_math``) folds in the current token's
+    self-logit with the standard two-estimate softmax merge, which is
+    exactly ``softmax(concat([scores, self]))`` up to summation order;
+  * the int8-KV pool dequantizes PER PAGE: int8 K/V pages DMA in as
+    int8 (half the bytes — the point of int8-KV), and the per-row f32
+    scales apply outside the contractions, mirroring the gather path's
+    register-upcast trick.
+
+Masking parity with the gather path (``_decode_step_math``): dead rows
+(causal ``j >= pos``, pad, sparse-layout holes) are filled with the
+same finite ``core.neg_inf`` fill; because the self-logit is always a
+live finite score, those rows underflow to weight 0.0 exactly in both
+implementations, so kernel-vs-gather agreement is limited only by
+summation order (allclose; emitted tokens byte-identical in practice —
+tests/test_paged_attention.py pins both). The gather path stays as the
+parity ORACLE: it is token-equal to the dense cache by construction,
+so any kernel regression surfaces as a diff against it rather than as
+silently wrong images.
+
+``interpret=None`` auto-selects the Pallas interpreter off-TPU (the
+flash_attention convention), so the same code path runs in tier-1 on
+the CPU mesh — including the DMA pipeline, which the interpreter
+emulates.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dalle_pytorch_tpu.ops import core
+
+# NOTE: this module deliberately has no module-level serve import (ops
+# must not depend on serve at import time — the dependency runs the
+# other way). The page-size gate lives in serve/kv_pool.py, next to the
+# other typed pool errors and importable without jax; the kernel entry
+# fetches it lazily.
+
+Array = jax.Array
+
+# finite mask fill, BY CONSTRUCTION the gather path's substitution
+# constant (ops.core.neg_inf): masked rows underflow to exactly 0
+# weight once any live score enters the running max, so degenerate rows
+# agree exactly between the kernel and the oracle — if neg_inf ever
+# changed, both paths would move together instead of silently diverging
+FILL = float(core.neg_inf(jnp.float32))
+
+NUM_LANES = 128        # f32 VREG lane width — m/l stats stored broadcast
+
+
+def _kernel(pos_ref, bt_ref, q_ref, allowed_ref, k_ref, v_ref, *refs,
+            scale: float, page_size: int, head_tile: int,
+            quantized: bool):
+    """One (slot, head-tile) program: walk the slot's mapped pages with
+    double-buffered HBM->VMEM DMA, accumulate the online softmax."""
+    if quantized:
+        (ksc_ref, vsc_ref, acc_ref, m_ref, l_ref,
+         kbuf, vbuf, kscb, vscb, sem_k, sem_v, sem_ks, sem_vs) = refs
+    else:
+        acc_ref, m_ref, l_ref, kbuf, vbuf, sem_k, sem_v = refs
+    t = pl.program_id(1)
+    ps, ht = page_size, head_tile
+    posi = pos_ref[0, 0]
+    # ragged trip count: rows [0, pos) span ceil(pos/ps) pages; a dead
+    # slot parked at pos 0 walks ZERO pages (its block-table entry 0
+    # points at the trash page, which is therefore never fetched)
+    n_pages = lax.div(posi + (ps - 1), ps)
+    heads0 = t * ht
+
+    def copies(slot, p):
+        """The (slot, page) DMA descriptor set — recreated identically
+        for start and wait (the wait must describe the copy it joins)."""
+        page = bt_ref[0, p]
+        hs = pl.ds(heads0, ht)
+        out = [pltpu.make_async_copy(k_ref.at[page, hs], kbuf.at[slot],
+                                     sem_k.at[slot]),
+               pltpu.make_async_copy(v_ref.at[page, hs], vbuf.at[slot],
+                                     sem_v.at[slot])]
+        if quantized:
+            out += [pltpu.make_async_copy(ksc_ref.at[page, hs],
+                                          kscb.at[slot], sem_ks.at[slot]),
+                    pltpu.make_async_copy(vsc_ref.at[page, hs],
+                                          vscb.at[slot], sem_vs.at[slot])]
+        return out
+
+    @pl.when(n_pages > 0)
+    def _warm():
+        for dma in copies(0, 0):
+            dma.start()
+
+    q = q_ref[0]                                           # (ht, dh)
+
+    def body(p, carry):
+        m, l, acc = carry             # (ht, 1), (ht, 1), (ht, dh) f32
+        slot = lax.rem(p, 2)
+        nxt = lax.rem(p + 1, 2)
+
+        # overlap: page p+1 streams in while page p is on the MXU
+        @pl.when(p + 1 < n_pages)
+        def _prefetch():
+            for dma in copies(nxt, p + 1):
+                dma.start()
+
+        for dma in copies(slot, p):
+            dma.wait()
+
+        ok = allowed_ref[0, pl.ds(p * ps, ps)] != 0        # (ps,)
+        # per-head 2-D MXU dots (static unroll over the tile): q_h
+        # (1, dh) x page (ps, dh)^T -> (1, ps) scores in f32
+        s_rows, pv_holder = [], []
+        for h in range(ht):
+            kb = kbuf[slot, h]
+            if quantized:
+                kb = kb.astype(q.dtype)
+            s_h = lax.dot_general(
+                q[h][None, :], kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if quantized:
+                # scales OUTSIDE the contraction — no dequantized page
+                # copy materializes (ops/decode.py's int8 discipline)
+                s_h = s_h * kscb[slot, h][None, :]
+            s_rows.append(s_h)
+        s = jnp.concatenate(s_rows, axis=0)                # (ht, ps)
+        s = jnp.where(ok[None, :], s, FILL)
+
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        pexp = jnp.exp(s - m_new)                          # (ht, ps)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + pexp.sum(axis=-1, keepdims=True)
+        wj = pexp
+        if quantized:
+            wj = wj * vscb[slot]                           # (ht, ps)
+        for h in range(ht):
+            vb = vbuf[slot, h]
+            if quantized:
+                vb = vb.astype(q.dtype)
+            pv_holder.append(lax.dot_general(
+                wj[h][None, :], vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))       # (1, dh)
+        acc = acc * alpha + jnp.concatenate(pv_holder, axis=0)
+        return m_new, l, acc
+
+    dh = q_ref.shape[-1]
+    m0 = jnp.full((ht, 1), FILL, jnp.float32)
+    l0 = jnp.zeros((ht, 1), jnp.float32)
+    a0 = jnp.zeros((ht, dh), jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_pages, body, (m0, l0, a0))
+
+    acc_ref[0] = acc
+    # lane-broadcast stats tiles (the flash_attention layout): Mosaic
+    # wants the last dim to be a 128-lane tile, and the caller reads
+    # lane 0
+    m_ref[0] = jnp.broadcast_to(m, (ht, NUM_LANES))
+    l_ref[0] = jnp.broadcast_to(l, (ht, NUM_LANES))
+
+
+def paged_decode_attention(q: Array, k_pages: Array, v_pages: Array,
+                           block_tables: Array, pos: Array,
+                           allowed: Array, *, scale: float,
+                           k_scales: Optional[Array] = None,
+                           v_scales: Optional[Array] = None,
+                           head_tile: int = 0,
+                           interpret: Optional[bool] = None,
+                           ) -> Tuple[Array, Array, Array]:
+    """Online-softmax attention partials over one layer's paged K/V.
+
+    q: (b, heads, dh) — the decode step's single query per slot.
+    k_pages/v_pages: (P, heads, page_size, dh) page pool (int8 when
+    quantized, with k_scales/v_scales (P, heads, page_size) f32).
+    block_tables: (b, max_pages) int32; pos: (b,) int32 per-slot
+    positions; allowed: (b, L) bool — the gather path's full row mask
+    (causal & pad & sparse), True = attend.
+
+    Returns f32 ``(acc, m, l)``: acc (b, heads, dh) the unnormalized
+    exp-weighted V sum over cached rows, m (b, heads) the running max
+    score, l (b, heads) the exp sum — the caller merges the self-logit
+    (ops.decode._decode_step_math) to complete the softmax. Rows the
+    mask kills carry exactly 0 weight (finite-FILL underflow), so a
+    slot at pos 0 returns (0, FILL, 0) and degrades to pure
+    self-attention, identical to the gather path.
+    """
+    from dalle_pytorch_tpu.serve import kv_pool as KV
+    b, heads, dh = q.shape
+    P, _, page_size, _ = k_pages.shape
+    L = allowed.shape[1]
+    KV.validate_page_size(page_size)
+    quantized = k_scales is not None
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    ht = int(head_tile) or heads
+    if heads % ht:
+        raise ValueError(f"head_tile {ht} must divide heads {heads}")
+    max_pages = block_tables.shape[1]
+    if max_pages * page_size < L:
+        raise ValueError(
+            f"block tables map {max_pages} pages of {page_size} rows "
+            f"< allowed length {L}")
+    # pad the mask out to whole pages: the last page can span logical
+    # rows past L, and pl.ds CLAMPS out-of-bounds starts (dynamic_slice
+    # semantics) — an unpadded mask would alias the tail page onto the
+    # wrong rows. Padding is False = never attended.
+    L_pages = max_pages * page_size
+    if L < L_pages:
+        allowed = jnp.pad(allowed, ((0, 0), (0, L_pages - L)))
+
+    kernel = functools.partial(
+        _kernel, scale=float(scale), page_size=page_size, head_tile=ht,
+        quantized=quantized)
+
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda i, t: (i, 0),
+                     memory_space=pltpu.SMEM),              # pos
+        pl.BlockSpec((1, max_pages), lambda i, t: (i, 0),
+                     memory_space=pltpu.SMEM),              # block table
+        pl.BlockSpec((1, ht, dh), lambda i, t: (i, t, 0)),  # q tile
+        pl.BlockSpec((1, L_pages), lambda i, t: (i, 0)),    # allowed row
+        pl.BlockSpec(memory_space=pltpu.ANY),               # K pool (HBM)
+        pl.BlockSpec(memory_space=pltpu.ANY),               # V pool (HBM)
+    ]
+    inputs = [pos.astype(jnp.int32).reshape(b, 1),
+              block_tables.astype(jnp.int32),
+              q, allowed.astype(jnp.int32), k_pages, v_pages]
+    scratch = [
+        pltpu.VMEM((2, ht, page_size, dh), k_pages.dtype),  # K double buf
+        pltpu.VMEM((2, ht, page_size, dh), v_pages.dtype),  # V double buf
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        inputs += [k_scales, v_scales]
+        scratch = scratch[:2] + [
+            pltpu.VMEM((2, ht, page_size), jnp.float32),
+            pltpu.VMEM((2, ht, page_size), jnp.float32),
+        ] + scratch[2:] + [pltpu.SemaphoreType.DMA((2,)),
+                           pltpu.SemaphoreType.DMA((2,))]
+
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid=(b, heads // ht),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, ht, dh), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((1, ht, NUM_LANES), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((1, ht, NUM_LANES), lambda i, t: (i, t, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, heads, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, heads, NUM_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, heads, NUM_LANES), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*inputs)
+    return acc, m[:, :, 0], l[:, :, 0]
+
+
+def modeled_kv_read_bytes_per_token(*, depth: int, heads: int,
+                                    dim_head: int, total_len: int,
+                                    page_size: int, prompt_len: int,
+                                    itemsize: int, impl: str,
+                                    quantized: bool = False) -> float:
+    """Analytic KV-read bytes per decoded token for one slot — the
+    number ``bench_serve --serve_paged_attn`` records for both legs
+    (HBM counters are not observable from the host, and on CPU the
+    kernel runs interpreted, so the comparison is a model: the gather
+    path reads the FULL ``total_len`` view every step regardless of
+    position, the kernel reads only the ``ceil(pos/page_size)`` mapped
+    pages, averaged over the decode span ``[prompt_len, total_len)``).
+    K + V both counted; the int8 pool adds one f32 scale per row per
+    K and V."""
+    row = 2 * dim_head * itemsize          # K + V
+    if quantized:
+        row += 2 * 4                        # per-row f32 scales
+    if impl == "gather":
+        rows = float(total_len)
+    elif impl == "kernel":
+        span = range(int(prompt_len), int(total_len))
+        rows = (sum(-(-p // page_size) for p in span)   # ceil(pos/ps)
+                * page_size / max(len(span), 1))
+    else:
+        raise ValueError(f"impl must be 'gather' or 'kernel', got "
+                         f"{impl!r}")
+    return depth * heads * rows * row

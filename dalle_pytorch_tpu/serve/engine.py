@@ -139,6 +139,7 @@ class Engine:
                  kv: str = "dense",
                  page_size: int = 0,
                  num_pages: int = 0,
+                 paged_attn: str = "gather",
                  clock: Callable[[], float] = time.perf_counter,
                  device=None):
         import jax
@@ -169,6 +170,20 @@ class Engine:
         self.kv = str(kv)
         if self.kv not in ("dense", "paged"):
             raise ValueError(f"kv must be 'dense' or 'paged', got {kv!r}")
+        # the paged K/V read implementation: 'gather' materializes the
+        # dense view through the block tables (the parity oracle);
+        # 'kernel' consumes them in place via the Pallas ragged
+        # paged-attention kernel (ops/paged_attention.py) — same fused
+        # one-compile emit-ring program, only the per-step read changes
+        self.paged_attn = str(paged_attn)
+        if self.paged_attn not in ("gather", "kernel"):
+            raise ValueError(f"paged_attn must be 'gather' or 'kernel', "
+                             f"got {paged_attn!r}")
+        if self.paged_attn == "kernel" and self.kv != "paged":
+            raise ValueError("paged_attn='kernel' requires kv='paged' "
+                             "(the kernel reads the page pool through "
+                             "block tables; the dense slot cache has "
+                             "neither)")
 
         if prefill_buckets is None:
             buckets = S.prefill_buckets(cfg.text_seq_len)
@@ -199,6 +214,10 @@ class Engine:
                 raise ValueError(
                     f"page_size must be in [1, seq_len={self.total_len}], "
                     f"got {self.page_size}")
+            if self.paged_attn == "kernel":
+                # typed, at pool init, naming the kernel tile constraint
+                # — not an opaque Mosaic failure inside pallas_call
+                KV.validate_page_size(self.page_size)
             # logical pages one full-length sequence spans = the block
             # table width; also the floor on the pool (ONE request must
             # always be able to run alone, or eviction could livelock)
@@ -355,8 +374,10 @@ class Engine:
     def _decode_impl_paged(self, params, cache, block_tables, cur_tok, pos,
                            active, keys, temp, topk_k, top_p):
         """The paged twin of ``_decode_impl``: identical fused K-step
-        emit-ring program, but K/V reads gather through the block tables
-        and writes scatter into the page pool
+        emit-ring program, but K/V reads go through the block tables —
+        the dense-view gather, or the in-place Pallas ragged
+        paged-attention kernel under ``paged_attn='kernel'`` — and
+        writes scatter into the page pool
         (``ops.decode.decode_loop_paged``). The block tables are a
         per-chunk constant — the host maps every page the chunk could
         write before dispatch — so this too traces exactly once."""
@@ -376,7 +397,8 @@ class Engine:
             params["transformer"], cur_tok, pos, active, cache,
             block_tables, cfg=self.cfg.transformer,
             key_mask=self.key_mask, total_len=self.total_len,
-            steps=self.chunk_steps, embed_fn=embed_fn, sample_fn=sample_fn)
+            steps=self.chunk_steps, embed_fn=embed_fn,
+            sample_fn=sample_fn, attn_impl=self.paged_attn)
 
     def _prefill_fn(self, bucket: int):
         """Admission program for one prompt-length BUCKET: batched prefill
@@ -1166,6 +1188,7 @@ class Engine:
         paged = {}
         if self.kv == "paged":
             paged = {
+                "paged_attn": self.paged_attn,
                 "page_size": self.page_size,
                 "num_pages": self.num_pages,
                 "pages_in_use": self.alloc.in_use,

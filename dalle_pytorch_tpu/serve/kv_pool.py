@@ -13,7 +13,10 @@ PAGES shared by every slot:
     carries per-row scale pages) plus per-slot block tables
     ``(num_slots, max_pages)`` int32 mapping logical page j → physical
     page id — ``ops.decode.paged_view`` / ``_store_rows_paged`` are the
-    gather/scatter through them;
+    gather/scatter through them, and ``ops.paged_attention`` is the
+    Pallas kernel that consumes the tables in place
+    (``paged_attn='kernel'``, which also imposes the page-size tile
+    constraint ``validate_page_size`` gates);
   * the host side is THIS module's ``PageAllocator``: a free-list over
     physical pages. Physical page 0 is reserved as the TRASH page —
     dead slots park their writes there (see ops/decode.py), so it is
@@ -45,6 +48,48 @@ from dalle_pytorch_tpu.utils.metrics import structured_event
 # physical page 0 is reserved: dead slots' parked writes land here, and
 # unmapped block-table entries point here (reads of it are never attended)
 TRASH_PAGE = 0
+
+# the ragged paged-attention kernel's tile constraints
+# (ops/paged_attention.py): a page is the kernel's K-tile, staged whole
+# into VMEM, so its row count must be at least one f32 sublane tile (8)
+# and a lane-friendly multiple of 8 — Mosaic cannot tile a 4-row page.
+# The gather path has no such floor (any page_size works there).
+KERNEL_MIN_PAGE_SIZE = 8
+KERNEL_PAGE_MULTIPLE = 8
+
+
+class PageSizeError(ValueError):
+    """Typed page-size rejection at pool init: the configured
+    ``page_size`` cannot feed the ragged paged-attention kernel
+    (``ops/paged_attention.py`` stages one page per DMA as a VMEM
+    K-tile, so pages must be >= the 8-row f32 sublane tile and a
+    multiple of 8 lanes' worth of rows). Raised HERE, with the
+    constraint named, instead of failing opaquely inside
+    ``pl.pallas_call``. ``record`` is the structured event."""
+
+    def __init__(self, record: dict):
+        super().__init__(
+            f"page_size={record.get('page_size')} cannot feed the "
+            f"ragged paged-attention kernel (ops/paged_attention.py): "
+            f"pages are staged whole into VMEM as the kernel's K-tile, "
+            f"so page_size must be >= {record.get('min_page_size')} "
+            f"(the f32 sublane tile) and a multiple of "
+            f"{record.get('page_multiple')}. Use --paged_attn gather "
+            f"for arbitrary page sizes.")
+        self.record = record
+
+
+def validate_page_size(page_size: int) -> None:
+    """Gate a pool's ``page_size`` against the kernel tile constraints
+    — called at pool init when ``paged_attn='kernel'`` is selected (and
+    again by the kernel entry itself, so a direct caller cannot reach
+    the opaque Mosaic failure either)."""
+    ps = int(page_size)
+    if ps < KERNEL_MIN_PAGE_SIZE or ps % KERNEL_PAGE_MULTIPLE:
+        raise PageSizeError(structured_event(
+            "serve_page_size_invalid", page_size=ps,
+            min_page_size=KERNEL_MIN_PAGE_SIZE,
+            page_multiple=KERNEL_PAGE_MULTIPLE))
 
 
 class PagePoolExhausted(RuntimeError):

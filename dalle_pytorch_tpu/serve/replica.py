@@ -148,6 +148,7 @@ class ReplicaSet:
                  kv: str = "dense",
                  page_size: int = 0,
                  num_pages: int = 0,
+                 paged_attn: str = "gather",
                  clock: Callable[[], float] = time.perf_counter,
                  heartbeat_s: float = 5.0,
                  bringup_policy=None,
@@ -188,7 +189,8 @@ class ReplicaSet:
             num_slots=num_slots, chunk_steps=chunk_steps,
             prefill_buckets=prefill_buckets, metrics=metrics,
             log_every=log_every, quantize_cache=quantize_cache,
-            kv=kv, page_size=page_size, num_pages=num_pages)
+            kv=kv, page_size=page_size, num_pages=num_pages,
+            paged_attn=paged_attn)
         if self.isolation == "process":
             import numpy as np
             # what crosses the spawn boundary: a host numpy pytree of
@@ -201,7 +203,8 @@ class ReplicaSet:
                 num_slots=num_slots, chunk_steps=chunk_steps,
                 prefill_buckets=prefill_buckets,
                 quantize_cache=quantize_cache,
-                kv=kv, page_size=page_size, num_pages=num_pages)
+                kv=kv, page_size=page_size, num_pages=num_pages,
+                paged_attn=paged_attn)
             # routing needs page math without an Engine in-process:
             # mirror the engine's bucket/page-size resolution
             self._buckets = (S.prefill_buckets(cfg.text_seq_len)

@@ -62,6 +62,7 @@ class InferenceServer:
                  kv: str = "dense",
                  page_size: int = 0,
                  num_pages: int = 0,
+                 paged_attn: str = "gather",
                  replicas: int = 1,
                  heartbeat_s: float = 5.0,
                  isolation: str = "thread",
@@ -108,6 +109,7 @@ class InferenceServer:
                 complete=self._on_decoded, metrics=metrics,
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
+                paged_attn=paged_attn,
                 heartbeat_s=heartbeat_s, isolation=isolation,
                 child_rss_limit_mb=child_rss_limit_mb)
         else:
@@ -116,7 +118,8 @@ class InferenceServer:
                 chunk_steps=chunk_steps, prefill_buckets=prefill_buckets,
                 complete=self._on_decoded, metrics=metrics,
                 log_every=log_every, quantize_cache=quantize_cache,
-                kv=kv, page_size=page_size, num_pages=num_pages)
+                kv=kv, page_size=page_size, num_pages=num_pages,
+                paged_attn=paged_attn)
 
         # bounded window: p50/p95 over the last 10k completions — an
         # unbounded list would grow (and re-sort under the lock) forever
