@@ -1708,6 +1708,144 @@ def _serve_isolation_compare(params, cfg, *, replicas, num_slots, n_req,
     return out
 
 
+def _serve_transport_compare(params, cfg, *, replicas, num_slots, n_req,
+                             kv, page_size, chunk_steps=8):
+    """The socket-transport tax, TRACKED rather than guessed: the same
+    process-isolated replica set under the same offered burst, frames
+    over a duplex pipe vs dial-back TCP (serve/transport.py), recording
+    ms/token and the measured IPC lag for both legs. Then the
+    robustness half host isolation exists for, ASSERTED: a connection
+    reset that tears a frame mid-stream (the deterministic network
+    fault) fences the replica on a TYPED protocol error and loses zero
+    requests — its shadow-reclaimed work replays on the survivor."""
+    import statistics as stats_mod
+
+    from dalle_pytorch_tpu.resilience import faults
+    from dalle_pytorch_tpu.serve import Request, RequestQueue, \
+        SamplingParams
+    from dalle_pytorch_tpu.serve.replica import ReplicaSet
+
+    prompt_len = min(4, cfg.text_seq_len)
+    n_load = max(n_req, 4 * replicas * num_slots)
+    tokens_per_req = cfg.seq_len - prompt_len
+    out = {"replicas": replicas, "requests": n_load,
+           "tokens_per_request": tokens_per_req}
+
+    def build(transport):
+        queue = RequestQueue(max_depth=max(4 * n_load, 16))
+        rs = ReplicaSet(params, cfg, queue, replicas=replicas,
+                        num_slots=num_slots, chunk_steps=chunk_steps,
+                        kv=kv,
+                        page_size=page_size if kv == "paged" else 0,
+                        isolation="process", transport=transport)
+        return rs, queue
+
+    def submit_burst(queue):
+        return [queue.submit(Request(
+            codes=(1 + i % 7,) * prompt_len, seed=i,
+            sampling=SamplingParams())) for i in range(n_load)]
+
+    for transport in ("pipe", "socket"):
+        rs, queue = build(transport)
+        # close on EVERY exit: a failed assertion must not leak live
+        # child workers + the listener into the rest of the bench run
+        try:
+            rs.start()
+            warm = [queue.submit(Request(codes=(1,) * prompt_len,
+                                         seed=i,
+                                         sampling=SamplingParams()))
+                    for i in range(replicas * num_slots)]
+            for h in warm:
+                h.result(timeout=300)
+            best = None
+            for _ in range(2):      # best-of-2: shave scheduler noise
+                t0 = time.perf_counter()
+                handles = submit_burst(queue)
+                ok = sum(h.result(timeout=300).status == "ok"
+                         for h in handles)
+                wall = time.perf_counter() - t0
+                if ok != n_load:
+                    raise AssertionError(
+                        f"transport={transport}: only {ok}/{n_load} "
+                        f"completed")
+                best = wall if best is None else min(best, wall)
+            lags = []
+            for r in rs.replicas:
+                if r.engine is not None:
+                    lags.extend(r.engine.ipc_lag_s)
+            leg = {
+                "wall_s": round(best, 4),
+                "throughput_imgs_per_s": round(n_load / best, 3),
+                "ms_per_token": round(
+                    1e3 * best / (n_load * tokens_per_req), 4),
+                "decode_compiles_per_replica":
+                    rs.decode_compiles_per_replica(),
+            }
+            if lags:
+                lags.sort()
+                leg["ipc_lag_ms_mean"] = round(
+                    1e3 * stats_mod.fmean(lags), 3)
+                leg["ipc_lag_ms_p95"] = round(
+                    1e3 * lags[min(int(0.95 * len(lags)),
+                                   len(lags) - 1)], 3)
+        finally:
+            rs.close()
+        if any(c != 1 for c in leg["decode_compiles_per_replica"]):
+            raise AssertionError(
+                f"transport={transport}: decode compiled "
+                f"{leg['decode_compiles_per_replica']} times — the "
+                f"one-compile-per-replica contract broke")
+        out[transport] = leg
+    pipe_ms = out["pipe"]["ms_per_token"]
+    out["socket_tax_pct"] = round(
+        100.0 * (out["socket"]["ms_per_token"] - pipe_ms) / pipe_ms, 1)
+
+    # the network-fault half: a connection reset that tears a heartbeat
+    # frame mid-stream on the last replica after its 2nd fused chunk.
+    # Zero lost requests, the fence reason typed (protocol error), the
+    # victim restarted.
+    events = []
+
+    class _Sink:
+        def event(self, **rec):
+            events.append(rec)
+
+    with faults.injected(fault_replica=replicas - 1,
+                         replica_conn_reset_at_chunk=2):
+        queue = RequestQueue(max_depth=max(4 * n_load, 16))
+        rs = ReplicaSet(params, cfg, queue, replicas=replicas,
+                        num_slots=num_slots, chunk_steps=chunk_steps,
+                        kv=kv,
+                        page_size=page_size if kv == "paged" else 0,
+                        isolation="process", transport="socket",
+                        metrics=_Sink())
+        ok = 0
+        try:
+            handles = submit_burst(queue)
+            rs.run_until_idle(max_steps=2_000_000)
+            ok = sum(h.result(timeout=120).status == "ok"
+                     for h in handles)
+        finally:
+            fenced = [e for e in events
+                      if e.get("kind") == "serve_replica_fenced"]
+            out["conn_reset_failover"] = {
+                "requests": n_load, "completed": ok,
+                "failovers": rs.failovers, "reclaimed": rs.reclaimed,
+                "fence_reason": fenced[0]["reason"] if fenced else ""}
+            rs.close()
+    if rs.failovers < 1:
+        raise AssertionError("injected connection reset never fired — "
+                             "the transport failover leg proved "
+                             "nothing")
+    if not fenced or "protocol error" not in fenced[0]["reason"]:
+        raise AssertionError(
+            f"conn reset fenced untyped: {fenced!r}")
+    if ok != n_load:
+        raise AssertionError(
+            f"connection reset lost requests: {ok}/{n_load} completed")
+    return out
+
+
 def bench_serve(args):
     """Serving-path bench: the continuous-batching engine
     (dalle_pytorch_tpu/serve) under an offered-load sweep, swept over the
@@ -1884,6 +2022,21 @@ def bench_serve(args):
             isolation_compare = {"error": f"{type(e).__name__}: {e}"}
             errors.append(str(e))
 
+    transport_compare = None
+    if args.replicas > 1 and args.isolation == "process" \
+            and args.transport == "socket":
+        _progress(f"serve: pipe-vs-socket transport tax + connection-"
+                  f"reset failover ({args.replicas} replicas)")
+        try:
+            transport_compare = _serve_transport_compare(
+                params, cfg, replicas=args.replicas,
+                num_slots=num_slots, n_req=n_req, kv=kv,
+                page_size=page_size)
+        except Exception as e:  # noqa: BLE001 — structured-error
+            # contract: the serve-faults socket CI leg greps for it
+            transport_compare = {"error": f"{type(e).__name__}: {e}"}
+            errors.append(str(e))
+
     best = k_sweep[-1]["results"][-1]
     record = {
         "metric": "serve engine offered-load sweep (device-resident "
@@ -1904,6 +2057,8 @@ def bench_serve(args):
         record["replica_compare"] = replica_compare
     if isolation_compare is not None:
         record["isolation_compare"] = isolation_compare
+    if transport_compare is not None:
+        record["transport_compare"] = transport_compare
     if errors:
         record["error"] = "; ".join(errors)
     return record
@@ -2037,6 +2192,19 @@ def main():
                          "complete every request via shadow-reclaim "
                          "replay (docs/SERVING.md 'Process "
                          "isolation')")
+    ap.add_argument("--transport", choices=("pipe", "socket"),
+                    default="pipe",
+                    help="bench_serve with --isolation process: "
+                         "'socket' adds the transport-tax leg — the "
+                         "same burst with frames over a duplex pipe vs "
+                         "dial-back TCP (ms/token + measured IPC lag "
+                         "per leg, socket_tax_pct) — and a network-"
+                         "fault leg: an injected connection reset that "
+                         "tears a frame mid-stream must fence on a "
+                         "typed protocol error and complete every "
+                         "request via shadow-reclaim replay "
+                         "(docs/SERVING.md 'Host isolation & socket "
+                         "transport')")
     args = ap.parse_args()
     if args.gen_quant and args.no_gen:
         ap.error("--gen_quant needs the generate half; drop --no_gen")

@@ -115,6 +115,43 @@ def build_parser() -> argparse.ArgumentParser:
                         "requests it held — replayed token-exact on a "
                         "survivor — never the server (docs/SERVING.md "
                         "'Process isolation')")
+    p.add_argument("--transport", choices=("pipe", "socket"),
+                   default="pipe",
+                   help="process-isolation frame transport: 'pipe' = "
+                        "duplex pipe to locally spawned children; "
+                        "'socket' = workers DIAL BACK to this server's "
+                        "listener with an authenticated HELLO, which "
+                        "is what makes host-per-engine isolation and "
+                        "remote workers possible — a connection reset, "
+                        "torn frame, stalled link, or duplicated/"
+                        "reordered delivery fences the replica and its "
+                        "work replays token-exact on a survivor "
+                        "(docs/SERVING.md 'Host isolation & socket "
+                        "transport')")
+    p.add_argument("--worker_endpoint", type=str,
+                   default="127.0.0.1:0",
+                   help="socket transport: HOST:PORT the worker "
+                        "listener binds (port 0 = ephemeral; bind "
+                        ":PORT or 0.0.0.0:PORT so workers on other "
+                        "hosts can reach it). The bound endpoint and "
+                        "attach token are printed at startup")
+    p.add_argument("--worker_cmd", type=str, default=None,
+                   help="socket transport: launcher command run once "
+                        "per replica with {endpoint}, {index}, and "
+                        "{token} placeholders (e.g. 'ssh tpu-b env "
+                        "DALLE_WORKER_TOKEN={token} python -m "
+                        "dalle_pytorch_tpu.serve.worker --connect "
+                        "{endpoint} --index {index}' — a plain env "
+                        "var does not cross ssh, so the remote form "
+                        "inlines it; local launchers can rely on the "
+                        "DALLE_WORKER_TOKEN env var instead and skip "
+                        "{token}). Pass an EMPTY string to launch "
+                        "nothing and attach hand-started workers. "
+                        "Default: spawn local children that dial back")
+    p.add_argument("--attach_token", type=str, default=None,
+                   help="socket transport: the shared HELLO token "
+                        "(default: generated and printed; hand-started "
+                        "workers export it as DALLE_WORKER_TOKEN)")
     p.add_argument("--child_rss_limit_mb", type=int, default=0,
                    help="process isolation: a child worker whose RSS "
                         "crosses this dies with exit 137 (the "
@@ -211,16 +248,26 @@ def main(argv=None):
         replicas=args.replicas, heartbeat_s=args.heartbeat_s,
         isolation=args.isolation,
         child_rss_limit_mb=args.child_rss_limit_mb,
+        transport=args.transport, worker_endpoint=args.worker_endpoint,
+        worker_cmd=args.worker_cmd, attach_token=args.attach_token,
         clip_params=clip_params, clip_cfg=clip_cfg, metrics=metrics,
         log_every=args.log_every, encode=vocab.encode,
         init_deadline_s=args.init_deadline_s,
         init_retries=args.init_retries).start()
     kv_desc = args.kv if args.kv == "dense" \
         else f"{args.kv}/{args.paged_attn}"
+    iso_desc = args.isolation if args.transport == "pipe" \
+        else f"{args.isolation}/{args.transport}"
     say(f"serving {dalle_path} on http://{args.host}:{args.port} "
-        f"({args.replicas} {args.isolation} replica(s) x "
+        f"({args.replicas} {iso_desc} replica(s) x "
         f"{args.num_slots} slots, K={args.chunk_steps}, kv={kv_desc}, "
         f"queue {args.queue_depth})")
+    if args.transport == "socket" and args.replicas > 1:
+        listener = server.engine.listener
+        say(f"worker endpoint {listener.advertise_endpoint} — attach "
+            f"a worker with: DALLE_WORKER_TOKEN={listener.token} "
+            f"python -m dalle_pytorch_tpu.serve.worker --connect "
+            f"{listener.advertise_endpoint} --index N")
     serve_http(server, args.host, args.port)
 
 

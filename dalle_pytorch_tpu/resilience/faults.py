@@ -27,6 +27,13 @@ Simulated faults (pytest -m faults exercises each):
       exactly once per activation per replica, so a restarted child
       never re-fires its own kill (fire-once is kept parent-side: the
       child's ``_fired`` set dies with it).
+  * NETWORK faults (socket transport)   -> on_worker_chunk
+      connection reset mid-frame (RST after half a frame), torn frame
+      (half a frame then FIN), stalled socket (open but silent),
+      duplicate and reordered frame delivery — the failure modes a
+      pipe can never exhibit, each of which must fence the replica via
+      typed errors and replay on a survivor (``--transport socket``
+      for the stream-tearing ones; dup/reorder work on any transport).
 """
 
 from __future__ import annotations
@@ -87,6 +94,31 @@ class FaultPlan:
     replica_segv_at_chunk: int = -1
     replica_oom_at_chunk: int = -1
     replica_garbage_frame_at_chunk: int = -1
+    # NETWORK faults (socket transport, serve/transport.py) — the
+    # failure modes a duplex pipe can never exhibit, each of which the
+    # parent must answer with a typed fence + replay, never a deadlock
+    # or a double-delivery:
+    #   * conn reset mid-frame: the worker writes HALF a valid frame,
+    #     then aborts the connection with an RST (SO_LINGER 0) — what a
+    #     dying NAT entry, a crashed host, or a yanked cable delivers;
+    #   * torn frame: half a frame then a clean FIN — a peer that died
+    #     between two writes of one frame;
+    #   * stalled socket: the connection stays accepted and open but
+    #     the worker goes silent for replica_hang_s — the parent must
+    #     fence off the heartbeat deadline without any thread blocking
+    #     on the unread socket;
+    #   * duplicate / reordered frames: the worker re-sends a frame
+    #     (same sequence number) or swaps two frames' wire order — the
+    #     per-connection sequence check must fence, because replay
+    #     correctness cannot survive double-absorbed or skipped frames.
+    # The first two need --transport socket (a pipe has no RST/stream
+    # tearing to inject); dup/reorder are transport-agnostic. All -1 =
+    # off, fire at most once, target fault_replica only.
+    replica_conn_reset_at_chunk: int = -1
+    replica_torn_frame_at_chunk: int = -1
+    replica_stall_socket_at_chunk: int = -1
+    replica_dup_frame_at_chunk: int = -1
+    replica_reorder_frames_at_chunk: int = -1
 
 
 _active: Optional[FaultPlan] = None
@@ -258,7 +290,9 @@ _oom_ballast: list = []
 def on_worker_chunk(replica: int, chunk: int, *,
                     emit_frame=None,
                     rss_limit_mb: int = 0,
-                    rss_mb=None) -> None:
+                    rss_mb=None,
+                    transport=None,
+                    sender=None) -> None:
     """Inside a child-process worker's loop (serve/worker.py), before
     each engine step — the HARD half of the serve fault catalog, which
     only a process can survive being injected with:
@@ -311,6 +345,69 @@ def on_worker_chunk(replica: int, chunk: int, *,
         # emit_frame checked BEFORE consuming the fire-once token: a
         # call without an emitter must not silently burn the fault
         emit_frame(b"\xde\xad\xbe\xef not a frame")
+
+    # -- the network catalog (see the FaultPlan field comments) ------------
+    def _heartbeat_frame(seq: int) -> bytes:
+        from dalle_pytorch_tpu.serve import ipc as _ipc
+        return _ipc.encode_frame(_ipc.HEARTBEAT, {"snap": None}, seq)
+
+    def _need_socket(fault: str):
+        if transport is None or getattr(transport, "kind", "") \
+                != "socket":
+            raise FaultInjected(
+                f"{fault} fired but the worker is not on a socket "
+                f"transport — a pipe has no stream tearing to inject; "
+                f"run with --transport socket, or this fault proves "
+                f"nothing")
+
+    if p.replica_conn_reset_at_chunk >= 0 \
+            and chunk >= p.replica_conn_reset_at_chunk \
+            and sender is not None and _once("worker_conn_reset"):
+        _need_socket("replica_conn_reset_at_chunk")
+        # half a valid frame on the wire, then an RST: the parent must
+        # surface a typed mid-frame error and fence, and this worker's
+        # next transport touch dies (exit 3) like any orphan
+        frame = _heartbeat_frame(sender.seq)
+        transport.send_partial_frame(frame, len(frame) // 2)
+        transport.reset_hard()
+    if p.replica_torn_frame_at_chunk >= 0 \
+            and chunk >= p.replica_torn_frame_at_chunk \
+            and sender is not None and _once("worker_torn_frame"):
+        _need_socket("replica_torn_frame_at_chunk")
+        # half a frame then a clean FIN — died between two writes; the
+        # split lands INSIDE the ipc header, the hardest spot to
+        # mis-parse quietly
+        frame = _heartbeat_frame(sender.seq)
+        transport.send_partial_frame(frame, 3)
+        transport.close()
+    if p.replica_stall_socket_at_chunk >= 0 \
+            and chunk >= p.replica_stall_socket_at_chunk \
+            and _once("worker_stall"):
+        # accepted, open, silent: no frames for replica_hang_s — only
+        # the heartbeat deadline can notice, and no parent thread may
+        # block on the unread socket while it does
+        time.sleep(p.replica_hang_s)
+    if p.replica_dup_frame_at_chunk >= 0 \
+            and chunk >= p.replica_dup_frame_at_chunk \
+            and emit_frame is not None and sender is not None \
+            and _once("worker_dup"):
+        # the same frame delivered twice (same sequence number): the
+        # second copy must fence, never double-absorb
+        frame = _heartbeat_frame(sender.seq)
+        sender.seq += 1
+        emit_frame(frame)
+        emit_frame(frame)
+    if p.replica_reorder_frames_at_chunk >= 0 \
+            and chunk >= p.replica_reorder_frames_at_chunk \
+            and emit_frame is not None and sender is not None \
+            and _once("worker_reorder"):
+        # two frames swapped on the wire: the gap at the first one
+        # must fence — absorbing them out of order could interleave
+        # results and the counters that explain them
+        a = sender.seq
+        sender.seq += 2
+        emit_frame(_heartbeat_frame(a + 1))
+        emit_frame(_heartbeat_frame(a))
 
 
 def on_replica_bringup(replica: int, attempt: int) -> None:

@@ -5,8 +5,10 @@ shape-agnostic; this module is the process shape. One replica = one
 child process (``serve/worker.py``) running its own Python interpreter,
 its own jax client, its own ``Engine`` — so a segfault in XLA, a host
 OOM kill, or an operator ``kill -9`` takes down ONE replica, not the
-set. Parent and child share nothing but a duplex pipe carrying framed,
-versioned, checksummed messages:
+set. Parent and child share nothing but a transport
+(``serve/transport.py``: a duplex pipe, or a dial-back TCP socket for
+host-per-engine isolation and remote attach) carrying framed,
+versioned, sequence-numbered, checksummed messages:
 
   parent -> child:  ADMIT (request batches), FENCE, SHUTDOWN, STATS_REQ
   child -> parent:  READY, HEARTBEAT, HARVEST (completed-result batches
@@ -33,6 +35,14 @@ Design rules, each load-bearing for the zero-loss contract:
     marks itself poisoned, and the supervisor fences the replica (kill
     + reclaim + replay) — the one safe response to a peer whose stream
     can no longer be believed.
+  * **Delivery order is verified, not assumed.** Every frame carries a
+    per-connection sequence number; a gap (lost frame) or a duplicate/
+    reordered delivery raises ``IPCError`` and fences the replica. A
+    pipe cannot reorder, but the zero-loss replay contract must not
+    depend on that accident of transport: a lossy or re-delivering
+    path (a proxy, a broken relay, a resumed stream) is caught at the
+    protocol layer, where fencing is the defined response — counters
+    and results can never be silently double-absorbed or skipped.
   * **Two clocks never cross the pipe raw.** Deadlines ship as
     remaining budget; latency is restamped against the parent's clock
     at fulfilment. The only cross-process timestamps are the snapshot
@@ -50,17 +60,25 @@ from __future__ import annotations
 
 import json
 import multiprocessing as mp
+import os
+import pickle
 import signal
 import struct
+import subprocess
 import time
 import zlib
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from dalle_pytorch_tpu.serve import scheduler as S
+from dalle_pytorch_tpu.serve import transport as T
 from dalle_pytorch_tpu.serve.engine import COUNTERS
+from dalle_pytorch_tpu.serve.transport import IPCError  # noqa: F401
+#                       (re-export: the typed error every layer fences on)
 
-PROTOCOL_VERSION = 1
+# v2: the header grew a per-connection frame sequence number, and the
+# handshake kinds (HELLO/HELLO_OK) joined for socket-transport attach
+PROTOCOL_VERSION = 2
 
 # frame kinds — parent -> child
 ADMIT = "admit"
@@ -74,14 +92,18 @@ HARVEST = "harvest"
 STATS = "stats"
 CRASH = "crash"
 BYE = "bye"
+# handshake (socket transport only; see transport.WorkerListener)
+HELLO = "hello"
+HELLO_OK = "hello_ok"
 
 KINDS = (ADMIT, FENCE, SHUTDOWN, STATS_REQ,
-         READY, HEARTBEAT, HARVEST, STATS, CRASH, BYE)
+         READY, HEARTBEAT, HARVEST, STATS, CRASH, BYE,
+         HELLO, HELLO_OK)
 _KIND_ID = {k: i for i, k in enumerate(KINDS)}
 
 _MAGIC = 0xD5
-# magic, version, kind, pad, crc32(payload)
-_HEADER = struct.Struct("<BBBxI")
+# magic, version, kind, pad, seq, crc32(payload)
+_HEADER = struct.Struct("<BBBxII")
 
 # results per harvest frame: keeps every frame comfortably under the
 # pipe's atomic-write buffer (a frame torn across writes by a kill
@@ -94,25 +116,19 @@ HARVEST_BATCH = 8
 OOM_EXIT = 137
 
 
-class IPCError(RuntimeError):
-    """A frame that cannot be believed: truncated, wrong magic, version
-    skew, checksum mismatch, unparseable payload, or fields of the
-    wrong shape. The only safe response is to FENCE the peer — a
-    stream that produced one lie may have corrupted anything."""
-
-
-def encode_frame(kind: str, payload: dict) -> bytes:
+def encode_frame(kind: str, payload: dict, seq: int = 0) -> bytes:
     body = json.dumps(payload, separators=(",", ":")).encode()
     return _HEADER.pack(_MAGIC, PROTOCOL_VERSION, _KIND_ID[kind],
-                        zlib.crc32(body)) + body
+                        seq & 0xFFFFFFFF, zlib.crc32(body)) + body
 
 
 def decode_frame(data: bytes):
-    """-> (kind, payload). Raises ``IPCError`` on anything untrustworthy."""
+    """-> (kind, payload, seq). Raises ``IPCError`` on anything
+    untrustworthy."""
     if len(data) < _HEADER.size:
         raise IPCError(f"truncated frame: {len(data)} bytes < "
                        f"{_HEADER.size}-byte header")
-    magic, version, kind_id, crc = _HEADER.unpack_from(data)
+    magic, version, kind_id, seq, crc = _HEADER.unpack_from(data)
     if magic != _MAGIC:
         raise IPCError(f"bad magic 0x{magic:02x}")
     if version != PROTOCOL_VERSION:
@@ -130,7 +146,23 @@ def decode_frame(data: bytes):
     if not isinstance(payload, dict):
         raise IPCError(f"payload must be an object, got "
                        f"{type(payload).__name__}")
-    return KINDS[kind_id], payload
+    return KINDS[kind_id], payload, seq
+
+
+def seq_check(got: int, expected: int) -> int:
+    """Verify one received frame's sequence number; returns the next
+    expected. A mismatch is a transport that lost, duplicated, or
+    reordered delivery — typed ``IPCError``, and the peer is fenced:
+    replay correctness cannot survive a stream whose order or
+    exactly-once delivery is broken. The wire field is u32; the
+    comparison masks so a counter past 2^32 doesn't false-fence."""
+    if got != (expected & 0xFFFFFFFF):
+        how = ("duplicate or reordered delivery"
+               if got < (expected & 0xFFFFFFFF)
+               else "gap: lost frame(s)")
+        raise IPCError(f"frame sequence broken: got seq {got}, "
+                       f"expected {expected & 0xFFFFFFFF} ({how})")
+    return expected + 1
 
 
 def engine_snapshot(engine, chunks: int, rss_mb: int,
@@ -178,7 +210,29 @@ class ChildEngineClient:
     frame), plus ``num_slots`` / ``kv`` / ``active_slots()`` /
     ``last_heartbeat`` / ``compiling`` / ``fenced`` /
     ``inflight_handles()``. What it adds is the process half: PID
-    liveness, exit decoding, the shadow bookkeeping, and hard-kill."""
+    liveness, exit decoding, the shadow bookkeeping, and hard-kill.
+
+    Three LAUNCH shapes, picked by ``transport`` + ``worker_cmd``:
+
+      * ``transport='pipe'`` (default): spawn a local child over a
+        duplex pipe — PR 8's shape, unchanged;
+      * ``transport='socket'``, ``worker_cmd=None``: spawn a local
+        child that DIALS BACK to the parent's ``WorkerListener`` and
+        receives its spec over the authenticated socket — same
+        supervision, network transport;
+      * ``transport='socket'``, ``worker_cmd=<template>``: launch the
+        worker via an operator command (``{endpoint}``/``{index}``/
+        ``{token}`` placeholders; ``{endpoint}`` is the advertised —
+        dialable — address, and the token also ships via the
+        ``DALLE_WORKER_TOKEN`` env var for local launchers) — e.g.
+        ``ssh otherhost env DALLE_WORKER_TOKEN={token} python -m
+        dalle_pytorch_tpu.serve.worker --connect {endpoint} --index
+        {index}``; ``worker_cmd=''`` launches NOTHING and waits for a
+        hand-started worker to dial in (remote attach). Either way the
+        attached worker is supervised exactly like a spawned child:
+        shadow bookkeeping, heartbeat deadline, fence→reclaim→replay.
+        Without a local PID, the socket itself is the liveness signal —
+        a reset or EOF on it declares the replica dead."""
 
     def __init__(self, params, cfg, *, index: int,
                  engine_kwargs: dict,
@@ -189,7 +243,10 @@ class ChildEngineClient:
                  fault_plan: Optional[dict] = None,
                  idle_sleep_s: float = 0.002,
                  clock: Callable[[], float] = time.perf_counter,
-                 on_done: Optional[Callable] = None):
+                 on_done: Optional[Callable] = None,
+                 transport: str = "pipe",
+                 listener: Optional[T.WorkerListener] = None,
+                 worker_cmd: Optional[str] = None):
         from dalle_pytorch_tpu.serve import worker as worker_mod
 
         self.clock = clock
@@ -198,6 +255,7 @@ class ChildEngineClient:
         self.chunk_steps = int(engine_kwargs.get("chunk_steps", 8))
         self.kv = str(engine_kwargs.get("kv", "dense"))
         self.on_done = on_done
+        self.transport_kind = str(transport)
         spec = {
             "index": self.index,
             "params": params,              # numpy pytree (picklable)
@@ -210,22 +268,75 @@ class ChildEngineClient:
             "faults": fault_plan,
             "idle_sleep_s": float(idle_sleep_s),
         }
-        # spawn, not fork: the parent holds a live jax runtime, and a
-        # forked copy of it is undefined behaviour — the child builds
-        # its own interpreter and its own jax client from scratch,
-        # which is the entire point of the isolation
-        ctx = mp.get_context("spawn")
-        self._conn, child_end = ctx.Pipe(duplex=True)
-        self._proc = ctx.Process(
-            target=worker_mod.worker_main, args=(spec, child_end),
-            daemon=True, name=f"serve-worker-{index}")
-        self._proc.start()
-        # the parent MUST close its copy of the child's end: the child
-        # detects parent death as EOF on the pipe, which only happens
-        # when no live process holds a write handle to this end
-        child_end.close()
-        self.pid = self._proc.pid
+        self._listener = listener
+        self._proc = None
+        self._popen = None
+        self._conn = None
+        self.pid: Optional[int] = None
+        self.peer = ""
+        self.remote_host = ""
+        self.awaiting_operator = False
+        if transport == "pipe":
+            # spawn, not fork: the parent holds a live jax runtime, and
+            # a forked copy of it is undefined behaviour — the child
+            # builds its own interpreter and its own jax client from
+            # scratch, which is the entire point of the isolation
+            ctx = mp.get_context("spawn")
+            parent_end, child_end = ctx.Pipe(duplex=True)
+            self._conn = T.PipeTransport(parent_end)
+            self._proc = ctx.Process(
+                target=worker_mod.worker_main, args=(spec, child_end),
+                daemon=True, name=f"serve-worker-{index}")
+            self._proc.start()
+            # the parent MUST close its copy of the child's end: the
+            # child detects parent death as EOF on the pipe, which only
+            # happens when no live process holds a write handle
+            child_end.close()
+            self.pid = self._proc.pid
+            self.peer = f"pipe:pid={self.pid}"
+        elif transport == "socket":
+            if listener is None:
+                raise ValueError("transport='socket' needs a "
+                                 "WorkerListener")
+            # the spec travels over the authenticated socket AFTER the
+            # HELLO, so a hand-started remote worker needs nothing but
+            # endpoint + token + index
+            listener.expect(self.index, pickle.dumps(spec))
+            if worker_cmd is None:
+                ctx = mp.get_context("spawn")
+                self._proc = ctx.Process(
+                    target=worker_mod.worker_main_dial,
+                    args=(listener.dial_host, listener.port,
+                          listener.token, self.index),
+                    daemon=True, name=f"serve-worker-{index}")
+                self._proc.start()
+                self.pid = self._proc.pid
+            elif worker_cmd == "":
+                # remote attach: an operator (or an external launcher)
+                # starts the worker by hand; no spawn deadline applies
+                self.awaiting_operator = True
+            else:
+                import shlex
+                # {endpoint} is the ADVERTISED address (a 0.0.0.0 bind
+                # is not a destination a remote host can dial); {token}
+                # is for launchers that cross a host boundary — a plain
+                # env var does not survive ssh (no SendEnv), so the
+                # documented ssh form inlines it via `env` on the far
+                # side. The env var still covers local launchers.
+                cmd = worker_cmd.format(
+                    endpoint=listener.advertise_endpoint,
+                    index=self.index, token=listener.token)
+                env = dict(os.environ)
+                env[T.TOKEN_ENV] = listener.token
+                self._popen = subprocess.Popen(shlex.split(cmd), env=env)
+                self.pid = self._popen.pid
+        else:
+            raise ValueError(f"unknown transport {transport!r}")
         self.started_t = self.clock()
+        # per-connection frame sequencing: over a socket, seq 0 of each
+        # direction was spent on HELLO/HELLO_OK during the handshake
+        self._tx_seq = 1 if transport == "socket" else 0
+        self._rx_seq = 1 if transport == "socket" else 0
 
         # lifecycle flags (single-owner: control thread / sync driver)
         self.ready = False
@@ -249,6 +360,7 @@ class ChildEngineClient:
         self.rss_mb = 0
         self.pages_free = -1
         self.last_heartbeat = self.clock()
+        self.last_frame_t = self.clock()    # ANY decoded frame stamps it
         self.stats_reply: Optional[dict] = None
         # child-stamp -> parent-absorb lag per frame (the isolation tax
         # bench_serve's --isolation leg reports); perf_counter is
@@ -264,17 +376,64 @@ class ChildEngineClient:
             return counters[name]
         raise AttributeError(name)
 
+    # -- transport adoption (socket dial-back) ------------------------------
+
+    def _maybe_attach(self) -> None:
+        """Adopt the transport a dialing worker completed the HELLO
+        handshake on (socket mode; the listener parks it under this
+        replica's index). Spawned-socket children, launcher-started
+        workers, and hand-started remote workers all arrive here."""
+        if self._conn is not None or self._listener is None:
+            return
+        t = self._listener.take(self.index)
+        if t is None:
+            return
+        # short send bound from here on: this transport is now driven
+        # by the control thread that supervises EVERY replica, and one
+        # worker that stops reading must cost a recorded send failure
+        # (fence + replay), never stall the others' heartbeat deadlines
+        t.set_send_timeout(2.0)
+        self._conn = t
+        self.peer = t.peer
+        hello = t.hello or {}
+        if self.pid is None:
+            # a remote worker's pid: triage info for /healthz, never a
+            # liveness signal — the socket is the liveness signal
+            pid = hello.get("pid")
+            self.pid = int(pid) if isinstance(pid, int) else None
+        self.remote_host = str(hello.get("host") or "")
+        if self.awaiting_operator:
+            self.awaiting_operator = False
+            # the wait for an operator was open-ended; supervision
+            # deadlines (attach -> READY) start now
+            self.started_t = self.clock()
+
     # -- sending ------------------------------------------------------------
 
     def _send(self, kind: str, payload: dict) -> bool:
-        try:
-            self._conn.send_bytes(encode_frame(kind, payload))
-            return True
-        except (OSError, ValueError, BrokenPipeError) as e:
-            # a dead pipe is not a protocol lie — PID liveness decides
-            # what happened; just record it for the failover reason
+        self._maybe_attach()
+        if self._conn is None:
             if not self.last_error:
-                self.last_error = f"pipe write failed: {e!r}"
+                self.last_error = "no worker transport attached yet"
+            return False
+        try:
+            self._conn.send_bytes(encode_frame(kind, payload,
+                                               self._tx_seq))
+            self._tx_seq += 1
+            return True
+        except (OSError, ValueError) as e:
+            if not self.last_error:
+                self.last_error = f"transport write failed: {e!r}"
+            # a write failure over a STILL-LIVE stream (a peer that
+            # stopped reading, a send timeout) leaves routed handles
+            # stranded unless someone fences: the dropped frame also
+            # un-syncs our tx sequence, so this stream can never be
+            # trusted again — poison, and the supervisor fences +
+            # replays the shadow. When the transport itself is dead,
+            # liveness (PID, or the socket state for a remote worker)
+            # already tells the story and fences the same way.
+            if self._conn.alive():
+                self.poisoned = True
             return False
 
     def route(self, handles: List[S.RequestHandle]) -> None:
@@ -300,6 +459,9 @@ class ChildEngineClient:
         the supervisor fences it on the next sweep."""
         if self.fenced:
             return False
+        self._maybe_attach()
+        if self._conn is None:
+            return False
         did = False
         first = True
         while True:
@@ -307,12 +469,23 @@ class ChildEngineClient:
                 if not self._conn.poll(poll_s if first else 0):
                     break
                 data = self._conn.recv_bytes()
+            except IPCError as e:
+                # the transport itself caught a lie: a torn frame, a
+                # reset mid-frame, an oversize length — fence material
+                self.poisoned = True
+                self.last_error = f"protocol error: {e}"
+                break
             except (EOFError, OSError):
-                break       # pipe closed: PID liveness tells the story
+                # clean close at a frame boundary: liveness (PID for a
+                # local child, the socket state for a remote worker)
+                # tells the story
+                break
             first = False
             did = True
             try:
-                kind, payload = decode_frame(data)
+                kind, payload, seq = decode_frame(data)
+                self._rx_seq = seq_check(seq, self._rx_seq)
+                self.last_frame_t = self.clock()
                 self._dispatch(kind, payload)
             except IPCError as e:
                 self.poisoned = True
@@ -385,14 +558,30 @@ class ChildEngineClient:
         return list(self.shadow.values())
 
     def alive_proc(self) -> bool:
-        return self._proc.is_alive()
+        """The replica's liveness, by the strongest signal available.
+        Over a socket, a dead CONNECTION means a dead replica whatever
+        the process state — an unreachable engine cannot serve, and a
+        remote worker has no PID to ask. With a local process (spawn)
+        or a launcher child (Popen), PID liveness layers on top. A
+        worker not yet attached counts as alive: the spawn/attach
+        deadline, not this check, bounds that phase."""
+        if self._conn is not None and self._conn.kind == "socket" \
+                and not self._conn.alive():
+            return False
+        if self._proc is not None:
+            return self._proc.is_alive()
+        if self._popen is not None:
+            if self._popen.poll() is None:
+                return True
+            # the launcher exited (an ssh relay dropping out): the
+            # worker may still be up — believe the live socket
+            return self._conn is not None and self._conn.alive()
+        if self._conn is None:
+            return True         # attach mode, still awaiting dial-in
+        return self._conn.alive()
 
-    def exit_desc(self) -> str:
-        """Decode how the child died — the second liveness signal. A
-        negative exitcode is the terminating signal (SIGKILL for a host
-        OOM killer or `kill -9`, SIGSEGV for an XLA crash); exit 137 is
-        the worker's own RSS watchdog (container OOM convention)."""
-        code = self._proc.exitcode
+    @staticmethod
+    def _decode_exit(code: Optional[int]) -> str:
         if code is None:
             return "running"
         if code < 0:
@@ -405,30 +594,82 @@ class ChildEngineClient:
             return f"oom-killed (exit {OOM_EXIT}: child RSS limit)"
         return f"exit code {code}"
 
+    def exit_desc(self) -> str:
+        """Decode how the child died — the second liveness signal. A
+        negative exitcode is the terminating signal (SIGKILL for a host
+        OOM killer or `kill -9`, SIGSEGV for an XLA crash); exit 137 is
+        the worker's own RSS watchdog (container OOM convention). A
+        worker with no local process (remote attach) has only the
+        connection's state to report."""
+        if self._proc is not None:
+            return self._decode_exit(self._proc.exitcode)
+        if self._popen is not None:
+            return self._decode_exit(self._popen.poll())
+        if self._conn is None:
+            return "no worker attached"
+        return f"remote worker: {self._conn.state_desc()}"
+
+    def transport_info(self, now: Optional[float] = None) -> dict:
+        """The per-replica transport block /healthz and /stats carry:
+        transport kind, peer address, and seconds since the last
+        decoded frame (the staleness an operator actually triages
+        with; heartbeat_age tracks only HEARTBEAT/HARVEST)."""
+        now = self.clock() if now is None else now
+        info = {"transport": self.transport_kind,
+                "peer": self.peer or "unattached",
+                "last_frame_age_s": round(
+                    max(now - self.last_frame_t, 0.0), 4)}
+        if self.remote_host:
+            info["worker_host"] = self.remote_host
+        return info
+
     # -- fencing / teardown -------------------------------------------------
 
     def fence(self) -> None:
         """One-way: after this, no frame from the child is ever
         processed again — its requests belong to the reclaim sweep.
-        The pipe end is released too (a fenced client never reads or
-        writes again; holding the fd would leak one pipe per
-        failover on a long-lived server)."""
+        The transport is released too (a fenced client never reads or
+        writes again; holding the fd would leak one per failover on a
+        long-lived server), and any dial-in expectation this replica
+        registered is cancelled so a stale worker cannot attach to a
+        fenced slot. Closing the socket is also what tells a live
+        remote worker its parent is gone — it EOFs and exits on its
+        own (the worker's no-leak contract)."""
         self.fenced = True
-        try:
-            self._conn.close()
-        except (OSError, AttributeError):
-            pass
+        if self._listener is not None:
+            try:
+                self._listener.cancel(self.index)
+            except Exception:   # noqa: BLE001 — teardown best-effort
+                pass
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
 
     def hard_kill(self, join_s: float = 5.0) -> None:
         """SIGKILL the child (idempotent; a corpse stays dead). No
         grace: by the time a replica is being fenced, its child is
-        crashed, wedged, or lying — all three deserve -9."""
-        if self._proc.is_alive():
+        crashed, wedged, or lying — all three deserve -9. A remote
+        worker has no process to signal — frames it already wrote
+        remain salvageable, and the fence's transport close is what
+        reaches it."""
+        if self._proc is not None:
+            if self._proc.is_alive():
+                try:
+                    self._proc.kill()
+                except (OSError, ValueError):
+                    pass
+            self._proc.join(join_s)
+        elif self._popen is not None:
             try:
-                self._proc.kill()
-            except (OSError, ValueError):
+                self._popen.kill()
+            except OSError:
                 pass
-        self._proc.join(join_s)
+            try:
+                self._popen.wait(join_s)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
 
     def salvage(self) -> None:
         """After the child is down: drain every complete frame it wrote
@@ -461,10 +702,32 @@ class ChildEngineClient:
 
     def close(self, timeout: float = 10.0) -> None:
         """Graceful shutdown: ask, wait, then kill. Frames written
-        before the child exited are salvaged either way."""
-        if self._proc.is_alive():
+        before the child exited are salvaged either way. A remote
+        worker (nothing to join) gets the SHUTDOWN frame and a bounded
+        pump for its BYE before the transport closes under it."""
+        if self._proc is not None:
+            # only wait for a child that actually HEARD the shutdown:
+            # a socket child still dialing (no transport attached) or
+            # a dead pipe would make this join burn its whole timeout
+            # on a worker with no reason to exit
+            if self._proc.is_alive() and self._send(SHUTDOWN, {}):
+                self._proc.join(timeout)
+        elif self._popen is not None:
+            if self._popen.poll() is None and self._send(SHUTDOWN, {}):
+                try:
+                    self._popen.wait(timeout)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+        elif self._conn is not None and self._conn.alive():
             self._send(SHUTDOWN, {})
-            self._proc.join(timeout)
+            deadline = time.perf_counter() + timeout
+            while not self.bye and time.perf_counter() < deadline:
+                # a worker that died or lied mid-shutdown will never
+                # BYE — stop waiting the moment the stream can say so
+                if self.poisoned or not self._conn.alive():
+                    break
+                if not self.pump(0.05):
+                    time.sleep(0.01)
         self.hard_kill()
         self.salvage()
         self.fence()

@@ -85,6 +85,27 @@ timestamp. A hard-killed child is fenced exactly like a crash or hang:
 its pipe is drained for frames written before death (those results
 stand), everything still open replays byte-identically on a survivor,
 and the dead replica restarts through the same circuit-breaker backoff.
+
+TRANSPORT SHAPES (process isolation only). ``transport='pipe'`` (the
+default) carries the frames over a duplex pipe — local children only.
+``transport='socket'`` makes isolation HOST-shaped: the parent opens
+one dial-in endpoint (``serve/transport.py``'s ``WorkerListener``;
+``worker_endpoint`` picks the bind address) and every worker CONNECTS
+BACK with an authenticated HELLO (shared token + protocol version +
+replica index), then receives its engine spec over the socket. Three
+ways a worker comes to exist — a locally spawned child that dials back
+(the default), a launcher command per replica (``worker_cmd`` with
+``{endpoint}``/``{index}`` placeholders, e.g. an ssh line; the token
+travels in the ``DALLE_WORKER_TOKEN`` env var), or a worker an
+operator starts BY HAND on another host (``worker_cmd=''``) — and all
+three are supervised identically: shadow bookkeeping, heartbeat
+deadline, fence→reclaim→replay at original arrival position. A worker
+with no local PID is declared dead off its socket (EOF/reset), and the
+frame protocol's per-connection sequence numbers + the transport's
+torn-frame detection turn every network failure mode — reset
+mid-frame, partial frame, stalled link, duplicated or reordered
+delivery — into the same typed fence + byte-identical replay a local
+`kill -9` gets (docs/SERVING.md 'Host isolation & socket transport').
 """
 
 from __future__ import annotations
@@ -102,6 +123,7 @@ BROKEN = "broken"        # circuit open: waiting out the bring-up backoff
 DRAINED = "drained"      # operator drain: down until undrain_replica()
 
 ISOLATION_MODES = ("thread", "process")
+TRANSPORT_MODES = ("pipe", "socket")
 
 
 class _Replica:
@@ -111,7 +133,8 @@ class _Replica:
 
     __slots__ = ("index", "state", "engine", "queue", "thread", "stop",
                  "device", "attempt", "bringups", "next_bringup_t",
-                 "last_error", "dead", "await_ready", "last_exit")
+                 "last_error", "dead", "await_ready", "last_exit",
+                 "conns")
 
     def __init__(self, index: int, device=None):
         self.index = index
@@ -128,6 +151,7 @@ class _Replica:
         self.dead = False            # loop thread recorded a crash
         self.await_ready = False     # process child spawned, READY due
         self.last_exit = ""          # decoded exit of the last child
+        self.conns = 0               # workers that reached READY here
 
 
 class ReplicaSet:
@@ -157,7 +181,11 @@ class ReplicaSet:
                  isolation: str = "thread",
                  child_rss_limit_mb: int = 0,
                  spawn_timeout_s: float = 120.0,
-                 compile_grace_s: float = 120.0):
+                 compile_grace_s: float = 120.0,
+                 transport: str = "pipe",
+                 worker_endpoint: str = "127.0.0.1:0",
+                 worker_cmd: Optional[str] = None,
+                 attach_token: Optional[str] = None):
         import jax
 
         from dalle_pytorch_tpu.resilience import faults
@@ -168,6 +196,16 @@ class ReplicaSet:
         if isolation not in ISOLATION_MODES:
             raise ValueError(f"isolation must be one of "
                              f"{ISOLATION_MODES}, got {isolation!r}")
+        if transport not in TRANSPORT_MODES:
+            raise ValueError(f"transport must be one of "
+                             f"{TRANSPORT_MODES}, got {transport!r}")
+        if transport == "socket" and isolation != "process":
+            raise ValueError("transport='socket' requires "
+                             "isolation='process' (threads share a "
+                             "heap; there is nothing to socket)")
+        if worker_cmd is not None and transport != "socket":
+            raise ValueError("worker_cmd needs transport='socket' — a "
+                             "pipe cannot cross a launcher boundary")
         # the CLI-harness fault path (DALLE_FAULTS): child plans are cut
         # at spawn time, so the env plan must be live before the first
         # bring-up — no-op when unset or already active
@@ -182,9 +220,19 @@ class ReplicaSet:
         self.heartbeat_s = float(heartbeat_s)
         self.kv = str(kv)
         self.isolation = str(isolation)
+        self.transport = str(transport)
+        self.worker_cmd = worker_cmd
         self.child_rss_limit_mb = int(child_rss_limit_mb)
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.compile_grace_s = float(compile_grace_s)
+        self.listener = None
+        if self.transport == "socket":
+            from dalle_pytorch_tpu.serve import transport as T
+            host, port = T.parse_endpoint(worker_endpoint)
+            self.listener = T.WorkerListener(
+                host, port, token=attach_token,
+                on_event=(lambda rec: self._event(rec.pop("kind"),
+                                                  **rec)))
         self._engine_kwargs = dict(
             num_slots=num_slots, chunk_steps=chunk_steps,
             prefill_buckets=prefill_buckets, metrics=metrics,
@@ -290,7 +338,10 @@ class ReplicaSet:
                     fault_plan=faults.child_plan_for(r.index),
                     idle_sleep_s=self._idle_sleep_s,
                     clock=self.clock,
-                    on_done=self._child_done)
+                    on_done=self._child_done,
+                    transport=self.transport,
+                    listener=self.listener,
+                    worker_cmd=self.worker_cmd)
             else:
                 queue = S.RequestQueue(
                     max_depth=4 * self._engine_kwargs["num_slots"] + 8,
@@ -544,7 +595,11 @@ class ReplicaSet:
                     r, now, f"child died in bring-up: "
                             f"{c.last_error or c.exit_desc()}")
                 return True
-            if now - c.started_t > self.spawn_timeout_s:
+            if now - c.started_t > self.spawn_timeout_s \
+                    and not c.awaiting_operator:
+                # an operator-attached worker has no spawn to time out:
+                # the slot waits (unroutable, harmless) until a worker
+                # dials in, and the deadline starts at attach
                 c.hard_kill()
                 self._bringup_fail_async(
                     r, now, f"child bring-up exceeded "
@@ -620,8 +675,10 @@ class ReplicaSet:
                 r.await_ready = False
                 r.attempt = 0
                 r.last_error = ""
+                r.conns += 1
                 self._event("serve_replica_up", replica=r.index,
-                            bringups=r.bringups, pid=c.pid)
+                            bringups=r.bringups, pid=c.pid,
+                            transport=c.transport_kind, peer=c.peer)
                 did = True
         return did
 
@@ -819,6 +876,8 @@ class ReplicaSet:
                             status=S.CANCELLED,
                             request_id=h.request.request_id,
                             reason="server shutdown"))
+                if self.listener is not None:
+                    self.listener.close()
             return
         with self._ctl_lock:
             for r in self.replicas:
@@ -975,10 +1034,12 @@ class ReplicaSet:
                     max(now - r.engine.last_heartbeat, 0.0), 4)
             if self.isolation == "process":
                 rec["restarts"] = max(r.bringups - 1, 0)
+                rec["reconnects"] = max(r.conns - 1, 0)
                 if r.engine is not None:
                     rec["pid"] = r.engine.pid
                     rec["rss_mb"] = r.engine.rss_mb
                     rec["ready"] = r.engine.ready
+                    rec.update(r.engine.transport_info(now))
                 if r.last_exit:
                     rec["last_exit"] = r.last_exit
             if r.last_error:
@@ -1020,7 +1081,9 @@ class ReplicaSet:
                 })
                 if proc:
                     rec.update({"pid": e.pid, "rss_mb": e.rss_mb,
-                                "restarts": max(r.bringups - 1, 0)})
+                                "restarts": max(r.bringups - 1, 0),
+                                "reconnects": max(r.conns - 1, 0)})
+                    rec.update(e.transport_info())
                     if r.last_exit:
                         rec["last_exit"] = r.last_exit
                     if e.kv == "paged" and e.pages_free >= 0:
@@ -1030,7 +1093,7 @@ class ReplicaSet:
             per.append(rec)
         tokens = self.tokens_decoded
         steps = self.decode_steps
-        return {
+        out = {
             "replicas": self.n_replicas,
             "isolation": self.isolation,
             "alive_replicas": sum(
@@ -1063,3 +1126,11 @@ class ReplicaSet:
             "evicted": self._agg("evicted"),
             "per_replica": per,
         }
+        if proc:
+            out["transport"] = self.transport
+            if self.listener is not None:
+                # where a remote worker dials in, and how many dialers
+                # the HELLO gate turned away
+                out["worker_endpoint"] = self.listener.endpoint
+                out["attach_rejected"] = self.listener.rejected
+        return out

@@ -67,6 +67,10 @@ class InferenceServer:
                  heartbeat_s: float = 5.0,
                  isolation: str = "thread",
                  child_rss_limit_mb: int = 0,
+                 transport: str = "pipe",
+                 worker_endpoint: str = "127.0.0.1:0",
+                 worker_cmd: Optional[str] = None,
+                 attach_token: Optional[str] = None,
                  clip_params: Optional[dict] = None, clip_cfg=None,
                  decode_images: bool = True,
                  metrics=None, log_every: int = 50,
@@ -85,6 +89,18 @@ class InferenceServer:
             # contract is replicas>1 — fail loudly instead of serving a
             # shape the operator almost certainly didn't mean
             raise ValueError("isolation='process' requires replicas >= 2")
+        if transport != "pipe" and isolation != "process":
+            # a transport only exists between a parent and worker
+            # processes; silently ignoring the flag would let an
+            # operator believe they were host-isolated when they weren't
+            raise ValueError(
+                f"transport={transport!r} requires isolation='process'")
+        if worker_cmd is not None and self.replicas < 2:
+            # the single-engine path would drop the launcher command on
+            # the floor — same silent-misconfiguration hazard as above
+            raise ValueError("worker_cmd requires replicas >= 2 with "
+                             "isolation='process' and "
+                             "transport='socket'")
         self.isolation = str(isolation)
 
         self.queue = S.RequestQueue(
@@ -111,7 +127,9 @@ class InferenceServer:
                 kv=kv, page_size=page_size, num_pages=num_pages,
                 paged_attn=paged_attn,
                 heartbeat_s=heartbeat_s, isolation=isolation,
-                child_rss_limit_mb=child_rss_limit_mb)
+                child_rss_limit_mb=child_rss_limit_mb,
+                transport=transport, worker_endpoint=worker_endpoint,
+                worker_cmd=worker_cmd, attach_token=attach_token)
         else:
             self.engine = engine_mod.Engine(
                 params, cfg, self.queue, num_slots=num_slots,

@@ -7,17 +7,33 @@ ship completed results and heartbeat snapshots back. The worker holds
 no authority: every request it runs also lives in the parent's shadow
 bookkeeping, so this process can die AT ANY INSTRUCTION — SIGKILL,
 SIGSEGV, OOM — and the supervisor replays its open work byte-identically
-on a survivor. The invariants the worker does own:
+on a survivor.
+
+The worker is TRANSPORT-AGNOSTIC (``serve/transport.py``): a spawned
+child over a duplex pipe (``worker_main``), a spawned child that dials
+back over TCP (``worker_main_dial``), and a worker started by hand on
+another host (``python -m dalle_pytorch_tpu.serve.worker --connect
+HOST:PORT --index N``, token in the ``DALLE_WORKER_TOKEN`` env var) all
+run the SAME loop — a dialing worker authenticates with a HELLO and
+receives its spec (params + config) over the socket, then is supervised
+exactly like a local child. The invariants the worker owns:
 
   * **Results and the counters that count them ride the same frame.**
     A completion is shipped in a harvest frame whose snapshot already
     includes it; the parent absorbs results before the snapshot. The
     prefix of frames that survives a mid-write kill is therefore always
     a consistent state (see ipc.py's module docstring).
-  * **A dead parent means exit, not a leak.** Every pipe read/write
-    and every idle nap goes through the connection; when the parent
-    dies the pipe EOFs/EPIPEs and the worker ``os._exit``\\ s — no
-    orphaned interpreters pinning devices after a parent crash.
+  * **A dead parent means exit, not a leak.** Every transport
+    read/write and every idle nap goes through the connection; when the
+    parent dies the transport EOFs/resets and the worker ``os._exit``\\ s
+    — no orphaned interpreters pinning devices after a parent crash.
+    Over a socket this covers the network deaths too: a reset or a
+    stalled parent that stops reading surfaces as a transport error and
+    the worker dies rather than running unsupervised.
+  * **Every frame is sequenced.** The worker numbers its frames and
+    verifies the parent's; a transport that loses, duplicates, or
+    reorders delivery is caught as a typed protocol error on whichever
+    side sees it first — never absorbed into the replay state.
   * **Local handles are stand-ins.** Admitted requests become child-
     local ``RequestHandle``\\ s (same request_id/queue_seq — replay
     identity survives the boundary); the engine fulfils them locally
@@ -43,8 +59,16 @@ from typing import Dict
 
 from dalle_pytorch_tpu.serve import ipc
 from dalle_pytorch_tpu.serve import scheduler as S
+from dalle_pytorch_tpu.serve import transport as T
 
 _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+# exit codes are protocol (the parent decodes them): 0 clean, 1 crash
+# (after a best-effort CRASH frame), 3 parent/transport gone, 4 the
+# parent rejected this worker's HELLO (bad token / index / version),
+# 137 RSS watchdog
+PARENT_GONE_EXIT = 3
+REJECTED_EXIT = 4
 
 
 def rss_mb() -> int:
@@ -61,29 +85,69 @@ def rss_mb() -> int:
         return peak >> 20 if sys.platform == "darwin" else peak >> 10
 
 
+class _FrameSender:
+    """The worker's one frame-writing point: every frame out carries
+    the next tx sequence number, so delivery-order violations are
+    detectable on the parent's side of any transport."""
+
+    def __init__(self, transport, start_seq: int):
+        self.transport = transport
+        self.seq = int(start_seq)
+
+    def send(self, kind: str, payload: dict) -> None:
+        self.transport.send_bytes(ipc.encode_frame(kind, payload,
+                                                   self.seq))
+        self.seq += 1
+
+
 def worker_main(spec: dict, conn) -> None:
-    """Spawn entrypoint (``multiprocessing`` 'spawn' context — never
-    fork a live jax runtime). Exit codes are part of the protocol:
-    0 clean (fence/shutdown), 1 crash (after a best-effort CRASH
-    frame), 3 parent-gone, 137 RSS watchdog. Signals show up as
-    negative exitcodes for the parent to decode."""
+    """Pipe-transport spawn entrypoint (``multiprocessing`` 'spawn'
+    context — never fork a live jax runtime)."""
+    _worker_shell(spec, T.PipeTransport(conn), start_seq=0)
+
+
+def worker_main_dial(host: str, port: int, token: str,
+                     index: int) -> None:
+    """Socket-transport spawn entrypoint: dial the parent's listener,
+    HELLO (token + protocol version + index), receive the spec over the
+    authenticated socket, then run the same loop. Also the body of the
+    hand-started remote worker (``main`` below)."""
     try:
-        _run(spec, conn)
-    except (EOFError, BrokenPipeError, ConnectionResetError):
-        os._exit(3)         # parent died: exit now, leak nothing
+        transport, spec = T.dial_parent(host, port, token, index)
+    except T.IPCError as e:
+        print(f"serve-worker[{index}]: attach rejected: {e}",
+              flush=True)
+        os._exit(REJECTED_EXIT)
+    except OSError as e:
+        print(f"serve-worker[{index}]: cannot reach parent "
+              f"{host}:{port}: {e}", flush=True)
+        os._exit(PARENT_GONE_EXIT)
+    # seq 0 of each direction was spent on HELLO/HELLO_OK
+    _worker_shell(spec, transport, start_seq=1)
+
+
+def _worker_shell(spec: dict, transport, start_seq: int) -> None:
+    """Run the loop; translate every way it can end into the exit-code
+    protocol. Signals show up as negative exitcodes for the parent to
+    decode."""
+    sender = _FrameSender(transport, start_seq)
+    try:
+        _run(spec, transport, sender, rx_seq=start_seq)
+    except (EOFError, BrokenPipeError, ConnectionResetError,
+            ConnectionAbortedError):
+        os._exit(PARENT_GONE_EXIT)  # parent/transport died: leak nothing
     except MemoryError:
         os._exit(ipc.OOM_EXIT)
     except BaseException as e:  # noqa: BLE001 — ship the reason, then die
         try:
-            conn.send_bytes(ipc.encode_frame(ipc.CRASH,
-                                             {"error": repr(e)}))
-        except Exception:   # noqa: BLE001 — the pipe may be gone too
+            sender.send(ipc.CRASH, {"error": repr(e)})
+        except Exception:   # noqa: BLE001 — the transport may be gone too
             pass
         os._exit(1)
     os._exit(0)
 
 
-def _run(spec: dict, conn) -> None:
+def _run(spec: dict, conn, sender: _FrameSender, rx_seq: int) -> None:
     from dalle_pytorch_tpu.resilience import faults
 
     # the parent decides which plan (if any) this child gets — NOT the
@@ -113,9 +177,8 @@ def _run(spec: dict, conn) -> None:
                     **spec["engine_kwargs"])
 
     open_handles: Dict[int, S.RequestHandle] = {}
-    conn.send_bytes(ipc.encode_frame(
-        ipc.READY, {"pid": os.getpid(), "device": str(device),
-                    "rss_mb": rss_mb()}))
+    sender.send(ipc.READY, {"pid": os.getpid(), "device": str(device),
+                            "rss_mb": rss_mb()})
 
     hb_interval = float(spec.get("heartbeat_interval_s", 0.05))
     idle_sleep = float(spec.get("idle_sleep_s", 0.002))
@@ -129,14 +192,17 @@ def _run(spec: dict, conn) -> None:
         payload = {"snap": snap}
         if results is not None:
             payload["results"] = results
-        conn.send_bytes(ipc.encode_frame(kind, payload))
+        sender.send(kind, payload)
         last_hb = time.perf_counter()
 
     while True:
-        # 1. parent frames (admission + control). recv_bytes raising
-        # EOFError here IS the parent-death path worker_main handles.
+        # 1. parent frames (admission + control). recv raising EOF /
+        # reset here IS the parent-death path _worker_shell handles;
+        # a broken sequence from the parent is a protocol error the
+        # worker dies loudly on (CRASH frame + exit 1).
         while conn.poll(0):
-            kind, payload = ipc.decode_frame(conn.recv_bytes())
+            kind, payload, seq = ipc.decode_frame(conn.recv_bytes())
+            rx_seq = ipc.seq_check(seq, rx_seq)
             if kind == ipc.ADMIT:
                 now = time.perf_counter()
                 for d in payload["requests"]:
@@ -148,8 +214,7 @@ def _run(spec: dict, conn) -> None:
                     queue.requeue(h, count=False)
             elif kind == ipc.FENCE:
                 engine.fence()
-                conn.send_bytes(ipc.encode_frame(
-                    ipc.BYE, {"reason": "fenced"}))
+                sender.send(ipc.BYE, {"reason": "fenced"})
                 return
             elif kind == ipc.SHUTDOWN:
                 engine.cancel_active("server shutdown")
@@ -158,26 +223,27 @@ def _run(spec: dict, conn) -> None:
                         status=S.CANCELLED,
                         request_id=h.request.request_id,
                         reason="server shutdown"))
-                conn.send_bytes(ipc.encode_frame(
-                    ipc.BYE, {"reason": "shutdown"}))
+                sender.send(ipc.BYE, {"reason": "shutdown"})
                 return
             elif kind == ipc.STATS_REQ:
-                conn.send_bytes(ipc.encode_frame(
-                    ipc.STATS, {"stats": engine.stats()}))
+                sender.send(ipc.STATS, {"stats": engine.stats()})
             else:
                 raise ipc.IPCError(
                     f"unexpected frame kind {kind!r} from parent")
 
         chunks = engine.decode_steps // engine.chunk_steps
         # the soft catalog (crash raises -> CRASH frame + exit 1; hang
-        # sleeps -> missed heartbeats -> the parent hard-kills) AND the
+        # sleeps -> missed heartbeats -> the parent hard-kills), the
         # hard catalog (real self-SIGKILL/SIGSEGV, OOM against the
-        # watchdog, a corrupt frame) both run here, making every serve
-        # fault process-drivable
+        # watchdog, a corrupt frame), and the NETWORK catalog (reset
+        # mid-frame, torn frame, stalled socket, duplicate/reordered
+        # frames) all run here, making every serve fault
+        # process-drivable
         faults.on_replica_chunk(index, chunks)
         faults.on_worker_chunk(index, chunks,
                                emit_frame=conn.send_bytes,
-                               rss_limit_mb=rss_limit, rss_mb=rss_mb)
+                               rss_limit_mb=rss_limit, rss_mb=rss_mb,
+                               transport=conn, sender=sender)
 
         # 2. RSS watchdog: die the way a container memory kill does —
         # abruptly, with no goodbye frame, exit 137
@@ -203,12 +269,49 @@ def _run(spec: dict, conn) -> None:
                 if i + ipc.HARVEST_BATCH >= len(wires):
                     send_snapshot(ipc.HARVEST, results=batch)
                 else:
-                    conn.send_bytes(ipc.encode_frame(
-                        ipc.HARVEST, {"results": batch, "snap": None}))
+                    sender.send(ipc.HARVEST,
+                                {"results": batch, "snap": None})
         elif time.perf_counter() - last_hb >= hb_interval:
             send_snapshot(ipc.HEARTBEAT)
 
-        # 5. idle nap ON THE PIPE: wakes early for new admissions and
-        # notices a dead parent even with nothing to do
+        # 5. idle nap ON THE TRANSPORT: wakes early for new admissions
+        # and notices a dead parent even with nothing to do
         if not busy and engine.idle():
             conn.poll(idle_sleep)
+
+
+def main(argv=None) -> None:
+    """The hand-started / launcher-started worker (remote attach):
+
+        DALLE_WORKER_TOKEN=<token> python -m dalle_pytorch_tpu.serve.worker \\
+            --connect HOST:PORT --index N
+
+    Dials the serving parent's ``--transport socket`` listener,
+    authenticates, receives its spec over the socket, and serves as
+    replica N until the parent fences it, shuts it down, or dies (any
+    of which ends this process — a worker never outlives its parent's
+    interest in it)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="dial into a serve_dalle --transport socket parent "
+                    "as one engine-replica worker")
+    p.add_argument("--connect", required=True, metavar="HOST:PORT",
+                   help="the parent's worker endpoint "
+                        "(serve_dalle --worker_endpoint)")
+    p.add_argument("--index", type=int, required=True,
+                   help="the replica index this worker serves as")
+    p.add_argument("--token", default="",
+                   help=f"HELLO token (prefer the {T.TOKEN_ENV} env "
+                        f"var — argv is visible in `ps`)")
+    args = p.parse_args(argv)
+    token = args.token or os.environ.get(T.TOKEN_ENV, "")
+    if not token:
+        raise SystemExit(f"no attach token: set {T.TOKEN_ENV} or pass "
+                         f"--token")
+    host, port = T.parse_endpoint(args.connect)
+    worker_main_dial(host, port, token, args.index)
+
+
+if __name__ == "__main__":
+    main()

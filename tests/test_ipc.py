@@ -1,27 +1,41 @@
-"""The process-isolation IPC layer (serve/ipc.py + serve/worker.py).
+"""The process-isolation IPC layer (serve/ipc.py + serve/transport.py +
+serve/worker.py).
 
-Three layers of proof, matching the layer's trust model:
+Four layers of proof, matching the layer's trust model:
 
   * the SERIALIZER is exact: framed round trips for every queue/result
     type — fuzzed requests (every sampling knob, priorities, deadlines)
     and results of every terminal status come back bit-identical,
     because deterministic replay across the process boundary depends on
     the decoded request being the same request;
-  * CORRUPTION is typed, never trusted: truncated frames, bad magic,
-    version skew, flipped payload bytes (CRC), garbage JSON, and
-    malformed snapshot/result fields all raise ``IPCError`` — and a
-    client fed a garbage frame marks itself poisoned (the supervisor's
-    fence signal) instead of deadlocking or mis-parsing;
-  * a WORKER whose parent dies notices the broken pipe and exits
-    instead of leaking an interpreter that pins a device.
+  * the TRANSPORT survives the stream: a socket legally delivers a
+    frame in arbitrary fragments, so the receive path is fuzzed over a
+    full split-point matrix (every byte boundary, plus random chunk
+    sizes) — and every way the stream can LIE (mid-frame EOF, torn
+    frame at any truncation point, reset, oversize length) surfaces as
+    a typed ``IPCError``, never a hang or a partial parse;
+  * CORRUPTION and DISORDER are typed, never trusted: truncated frames,
+    bad magic, version skew, flipped payload bytes (CRC), garbage JSON,
+    malformed snapshot/result fields, and broken frame SEQUENCES (gap,
+    duplicate, reorder) all raise ``IPCError`` — and a client fed any
+    of them marks itself poisoned (the supervisor's fence signal)
+    instead of deadlocking or mis-parsing;
+  * the HELLO handshake gates attach: a dialing worker with the right
+    token joins and receives its spec over the socket; a bad token, an
+    unexpected index, or a silent dialer is dropped without touching
+    any replica's state.
 
 The process-level failover semantics (SIGKILL mid-decode, OOM kills,
-shadow reclaim) live in tests/test_replica.py's process classes; this
-file owns the protocol itself.
+network faults, shadow reclaim) live in tests/test_replica.py's process
+classes; this file owns the protocol itself.
 """
 
 import multiprocessing as mp
+import pickle
 import random
+import socket
+import struct
+import threading
 import time
 
 import numpy as np
@@ -29,6 +43,7 @@ import pytest
 
 from dalle_pytorch_tpu.serve import ipc
 from dalle_pytorch_tpu.serve import scheduler as S
+from dalle_pytorch_tpu.serve import transport as T
 
 # ---------------------------------------------------------------------------
 # frame codec
@@ -37,11 +52,13 @@ from dalle_pytorch_tpu.serve import scheduler as S
 
 class TestFrameCodec:
     def test_round_trip_every_kind(self):
-        for kind in ipc.KINDS:
+        for i, kind in enumerate(ipc.KINDS):
             payload = {"kind": kind, "n": 3, "x": [1, 2.5, None, "s"]}
-            k2, p2 = ipc.decode_frame(ipc.encode_frame(kind, payload))
+            k2, p2, seq = ipc.decode_frame(
+                ipc.encode_frame(kind, payload, seq=i))
             assert k2 == kind
             assert p2 == payload
+            assert seq == i
 
     def test_empty_and_truncated_frames_raise(self):
         with pytest.raises(ipc.IPCError, match="truncated"):
@@ -87,13 +104,151 @@ class TestFrameCodec:
         # a frame whose body parses but is not a JSON object is as
         # untrustworthy as garbage — build one by hand
         import json
-        import struct
         import zlib
         body = json.dumps([1, 2, 3]).encode()
-        frame = struct.Struct("<BBBxI").pack(
-            0xD5, ipc.PROTOCOL_VERSION, 4, zlib.crc32(body)) + body
+        frame = struct.Struct("<BBBxII").pack(
+            0xD5, ipc.PROTOCOL_VERSION, 4, 0, zlib.crc32(body)) + body
         with pytest.raises(ipc.IPCError, match="object"):
             ipc.decode_frame(frame)
+
+    def test_seq_check_gap_and_duplicate_are_typed(self):
+        assert ipc.seq_check(5, 5) == 6
+        with pytest.raises(ipc.IPCError, match="duplicate or reordered"):
+            ipc.seq_check(4, 5)
+        with pytest.raises(ipc.IPCError, match="gap"):
+            ipc.seq_check(7, 5)
+
+
+# ---------------------------------------------------------------------------
+# socket transport: short reads, torn frames, resets (the stream matrix)
+# ---------------------------------------------------------------------------
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, T.SocketTransport(b)
+
+
+def _framed(frame: bytes) -> bytes:
+    return struct.pack("<I", len(frame)) + frame
+
+
+class TestSocketTransport:
+    FRAME = None    # built once; the matrix walks every byte of it
+
+    @classmethod
+    def setup_class(cls):
+        cls.FRAME = ipc.encode_frame(
+            ipc.HARVEST, {"results": [{"k": i} for i in range(4)],
+                          "snap": None}, seq=7)
+
+    def test_split_point_matrix_every_byte_boundary(self):
+        """THE short-read contract: deliver the framed bytes split at
+        EVERY possible byte boundary (two writes per split point); the
+        receiver must never surface a frame early, never lose bytes,
+        and decode the identical frame whatever the fragmentation."""
+        framed = _framed(self.FRAME)
+        for split in range(1, len(framed)):
+            a, tb = _pair()
+            a.sendall(framed[:split])
+            assert not tb.poll(0), f"frame surfaced early at {split}"
+            a.sendall(framed[split:])
+            assert tb.poll(0.5)
+            kind, payload, seq = ipc.decode_frame(tb.recv_bytes())
+            assert (kind, seq) == (ipc.HARVEST, 7)
+            assert payload["results"] == [{"k": i} for i in range(4)]
+            a.close()
+
+    def test_fuzzed_random_fragmentation_many_frames(self):
+        """Random chunking over a multi-frame stream: 50 frames written
+        in random 1..17-byte slices arrive intact, in order, with
+        sequence numbers consecutive — however the network fragments."""
+        rng = random.Random(0xF4A6)
+        frames = [ipc.encode_frame(ipc.HEARTBEAT, {"i": i}, seq=i)
+                  for i in range(50)]
+        stream = b"".join(_framed(f) for f in frames)
+        a, tb = _pair()
+
+        def dribble():
+            off = 0
+            while off < len(stream):
+                n = rng.randrange(1, 18)
+                a.sendall(stream[off:off + n])
+                off += n
+            a.close()
+
+        t = threading.Thread(target=dribble)
+        t.start()
+        got, expected_seq = [], 0
+        while len(got) < len(frames):
+            assert tb.poll(2.0), "stream stalled mid-fuzz"
+            kind, payload, seq = ipc.decode_frame(tb.recv_bytes())
+            expected_seq = ipc.seq_check(seq, expected_seq)
+            got.append(payload["i"])
+        t.join()
+        assert got == list(range(50))
+
+    def test_mid_frame_eof_every_truncation_point_is_typed(self):
+        """A peer dying between two writes of one frame: truncate the
+        framed bytes at every point AFTER the length prefix and close —
+        the receiver must raise ``IPCError`` (torn frame), never hand
+        up a partial parse and never wait forever."""
+        framed = _framed(self.FRAME)
+        # a handful of spread points plus both edges of the body keeps
+        # the matrix meaningful without quadratic test time
+        points = sorted({1, 2, 3, 5, 8, len(framed) // 2,
+                         len(framed) - 2, len(framed) - 1})
+        for cut in points:
+            a, tb = _pair()
+            a.sendall(framed[:cut])
+            a.close()
+            assert tb.poll(0.5)
+            if cut < len(framed):
+                with pytest.raises((T.IPCError, EOFError)) as ei:
+                    tb.recv_bytes()
+                if cut > 4:     # inside the frame proper: typed tear
+                    assert isinstance(ei.value, T.IPCError)
+                    assert "mid-frame EOF" in str(ei.value)
+
+    def test_clean_eof_at_frame_boundary_is_eoferror(self):
+        """A peer that closes BETWEEN frames is a death, not a lie:
+        plain ``EOFError`` — liveness decides what happened."""
+        a, tb = _pair()
+        a.sendall(_framed(self.FRAME))
+        a.close()
+        assert tb.poll(0.5)
+        ipc.decode_frame(tb.recv_bytes())
+        assert tb.poll(0.5)
+        with pytest.raises(EOFError):
+            tb.recv_bytes()
+        assert not tb.alive()
+
+    def test_reset_mid_frame_is_typed(self):
+        """The conn-reset fault's receive side: half a frame then an
+        abortive close (RST where TCP allows it) raises ``IPCError``
+        with the partial-frame context."""
+        a, tb = _pair()
+        ta = T.SocketTransport(a)
+        ta.send_partial_frame(self.FRAME, len(self.FRAME) // 2)
+        ta.reset_hard()
+        assert tb.poll(0.5)
+        with pytest.raises(T.IPCError, match="mid-frame EOF"):
+            tb.recv_bytes()
+
+    def test_oversize_length_prefix_is_typed_not_allocated(self):
+        a, tb = _pair()
+        a.sendall(struct.pack("<I", T.MAX_FRAME_BYTES + 1) + b"x" * 64)
+        assert tb.poll(0.5)
+        with pytest.raises(T.IPCError, match="cap"):
+            tb.recv_bytes()
+
+    def test_poll_timeout_never_blocks_past_deadline(self):
+        """A stalled peer (accepted, silent) costs at most the poll
+        timeout — the no-deadlock half of the stalled-socket fault."""
+        _, tb = _pair()
+        t0 = time.perf_counter()
+        assert not tb.poll(0.1)
+        assert time.perf_counter() - t0 < 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -130,8 +285,8 @@ class TestWireRoundTrip:
         for i in range(200):
             h = _random_request(rng, i)
             frame = ipc.encode_frame(
-                ipc.ADMIT, {"requests": [h.to_wire(now)]})
-            _, payload = ipc.decode_frame(frame)
+                ipc.ADMIT, {"requests": [h.to_wire(now)]}, seq=i)
+            _, payload, _ = ipc.decode_frame(frame)
             h2 = S.RequestHandle.from_wire(payload["requests"][0],
                                            now=now)
             r, r2 = h.request, h2.request
@@ -177,7 +332,7 @@ class TestWireRoundTrip:
                      reason="queue_full"),
         ]
         for res in cases:
-            _, payload = ipc.decode_frame(ipc.encode_frame(
+            _, payload, _ = ipc.decode_frame(ipc.encode_frame(
                 ipc.HARVEST, {"results": [res.to_wire()], "snap": None}))
             res2 = S.Result.from_wire(payload["results"][0])
             assert res2.status == res.status
@@ -207,7 +362,9 @@ class TestWireRoundTrip:
 
 
 class _FakeConn:
-    """Stands in for the parent end of the pipe: scripted frames."""
+    """Stands in for the parent end of the transport: scripted frames."""
+
+    kind = "fake"
 
     def __init__(self, frames):
         self.frames = list(frames)
@@ -221,6 +378,9 @@ class _FakeConn:
         return self.frames.pop(0)
 
     def send_bytes(self, data):
+        pass
+
+    def close(self):
         pass
 
 
@@ -242,10 +402,26 @@ def _client_shell():
     c.compiling = False
     c.pages_free = -1
     c.last_heartbeat = time.perf_counter()
+    c.last_frame_t = time.perf_counter()
     c.stats_reply = None
+    c.transport_kind = "pipe"
+    c.peer = "fake"
+    c.remote_host = ""
+    c.awaiting_operator = False
+    c.pid = 1
+    c._listener = None
+    c._proc = None
+    c._popen = None
+    c._tx_seq = 0
+    c._rx_seq = 0
     from collections import deque
     c.ipc_lag_s = deque(maxlen=100)
     return c
+
+
+def _frames(*kind_payloads, start_seq=0):
+    return [ipc.encode_frame(k, p, seq=start_seq + i)
+            for i, (k, p) in enumerate(kind_payloads)]
 
 
 class TestClientPoisoning:
@@ -259,21 +435,50 @@ class TestClientPoisoning:
         assert "protocol error" in c.last_error
 
     def test_malformed_snapshot_poisons(self):
-        frame = ipc.encode_frame(ipc.HEARTBEAT,
-                                 {"snap": {"counters": "nope"}})
         c = _client_shell()
-        c._conn = _FakeConn([frame])
+        c._conn = _FakeConn(_frames(
+            (ipc.HEARTBEAT, {"snap": {"counters": "nope"}})))
         c.pump()
         assert c.poisoned and "malformed snapshot" in c.last_error
 
     def test_malformed_result_poisons(self):
-        frame = ipc.encode_frame(
-            ipc.HARVEST,
-            {"results": [{"id": 1, "status": 5}], "snap": None})
         c = _client_shell()
-        c._conn = _FakeConn([frame])
+        c._conn = _FakeConn(_frames(
+            (ipc.HARVEST,
+             {"results": [{"id": 1, "status": 5}], "snap": None})))
         c.pump()
         assert c.poisoned and "malformed result" in c.last_error
+
+    def test_duplicate_frame_seq_poisons(self):
+        """A transport that re-delivers: the same frame (same seq)
+        twice — the first absorbs, the second fences. Nothing is ever
+        double-absorbed."""
+        c = _client_shell()
+        frame = ipc.encode_frame(ipc.HEARTBEAT, {"snap": None}, seq=0)
+        c._conn = _FakeConn([frame, frame])
+        c.pump()
+        assert c.poisoned
+        assert "duplicate or reordered" in c.last_error
+
+    def test_seq_gap_poisons(self):
+        """A transport that LOST a frame: the gap is detected at the
+        next frame and the replica is fenced — counters that rode the
+        lost frame can never be silently skipped."""
+        c = _client_shell()
+        c._conn = _FakeConn([
+            ipc.encode_frame(ipc.HEARTBEAT, {"snap": None}, seq=0),
+            ipc.encode_frame(ipc.HEARTBEAT, {"snap": None}, seq=2)])
+        c.pump()
+        assert c.poisoned
+        assert "gap" in c.last_error
+
+    def test_reordered_frames_poison(self):
+        c = _client_shell()
+        c._conn = _FakeConn([
+            ipc.encode_frame(ipc.HEARTBEAT, {"snap": None}, seq=1),
+            ipc.encode_frame(ipc.HEARTBEAT, {"snap": None}, seq=0)])
+        c.pump()
+        assert c.poisoned       # the gap at seq 1 fences immediately
 
     def test_fenced_client_drops_frames(self):
         """A zombie child's late result must never fulfil a handle the
@@ -286,8 +491,8 @@ class TestClientPoisoning:
                        tokens=np.asarray([1, 2], np.int32))
         frame = ipc.encode_frame(
             ipc.HARVEST, {"results": [res.to_wire()], "snap": None})
-        c.fence()
         c._conn = _FakeConn([frame])
+        c.fence()
         assert c.pump() is False
         assert not h.done()
 
@@ -315,6 +520,94 @@ class TestClientPoisoning:
         # retire math un-credits the reclaimed request's 2-token prefix
         retired = c.retire_counters(reclaimed)
         assert retired["tokens_decoded"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the HELLO handshake (listener-side auth gate; no engine needed)
+# ---------------------------------------------------------------------------
+
+
+class TestHelloHandshake:
+    def test_good_token_attaches_and_receives_spec(self):
+        listener = T.WorkerListener("127.0.0.1", 0,
+                                    handshake_timeout_s=5.0)
+        try:
+            spec = {"index": 3, "hello": "world", "n": [1, 2, 3]}
+            listener.expect(3, pickle.dumps(spec))
+            transport, got = T.dial_parent(
+                "127.0.0.1", listener.port, listener.token, 3,
+                timeout_s=10.0)
+            assert got == spec
+            deadline = time.perf_counter() + 5
+            attached = None
+            while attached is None and time.perf_counter() < deadline:
+                attached = listener.take(3)
+                time.sleep(0.01)
+            assert attached is not None, "handshake never registered"
+            assert attached.hello.get("pid") == __import__("os").getpid()
+            # the attached pair is a live duplex stream
+            transport.send_bytes(ipc.encode_frame(
+                ipc.READY, {"pid": 1, "rss_mb": 1}, seq=1))
+            assert attached.poll(2.0)
+            kind, _, seq = ipc.decode_frame(attached.recv_bytes())
+            assert (kind, seq) == (ipc.READY, 1)
+            transport.close()
+        finally:
+            listener.close()
+
+    def test_bad_token_rejected_without_attaching(self):
+        listener = T.WorkerListener("127.0.0.1", 0,
+                                    handshake_timeout_s=5.0)
+        try:
+            listener.expect(0, pickle.dumps({"x": 1}))
+            with pytest.raises(T.IPCError):
+                T.dial_parent("127.0.0.1", listener.port,
+                              "wrong-token", 0, timeout_s=5.0)
+            deadline = time.perf_counter() + 1
+            while listener.rejected < 1 \
+                    and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert listener.rejected >= 1
+            assert listener.take(0) is None
+        finally:
+            listener.close()
+
+    def test_unexpected_index_rejected(self):
+        listener = T.WorkerListener("127.0.0.1", 0,
+                                    handshake_timeout_s=5.0)
+        try:
+            listener.expect(0, pickle.dumps({"x": 1}))
+            with pytest.raises(T.IPCError):
+                T.dial_parent("127.0.0.1", listener.port,
+                              listener.token, 7, timeout_s=5.0)
+            assert listener.take(7) is None
+            assert listener.take(0) is None     # 0 still unattached
+        finally:
+            listener.close()
+
+    def test_silent_dialer_times_out_without_blocking_others(self):
+        """The stalled-socket shape at the handshake: a connection that
+        says nothing is dropped on the handshake deadline while a
+        well-behaved worker attaches concurrently."""
+        listener = T.WorkerListener("127.0.0.1", 0,
+                                    handshake_timeout_s=0.3)
+        try:
+            listener.expect(0, pickle.dumps({"ok": True}))
+            silent = socket.create_connection(
+                ("127.0.0.1", listener.port))
+            transport, got = T.dial_parent(
+                "127.0.0.1", listener.port, listener.token, 0,
+                timeout_s=10.0)
+            assert got == {"ok": True}
+            deadline = time.perf_counter() + 2
+            while listener.rejected < 1 \
+                    and time.perf_counter() < deadline:
+                time.sleep(0.02)
+            assert listener.rejected >= 1       # the silent one
+            silent.close()
+            transport.close()
+        finally:
+            listener.close()
 
 
 # ---------------------------------------------------------------------------
@@ -362,7 +655,7 @@ class TestWorkerLifecycle:
         ready = False
         while time.perf_counter() < deadline:
             if parent_end.poll(0.1):
-                kind, _ = ipc.decode_frame(parent_end.recv_bytes())
+                kind, _, _ = ipc.decode_frame(parent_end.recv_bytes())
                 if kind == ipc.READY:
                     ready = True
                     break
@@ -371,3 +664,69 @@ class TestWorkerLifecycle:
         proc.join(30)
         assert proc.exitcode == 3, \
             f"worker leaked (exitcode={proc.exitcode})"
+
+    def test_socket_worker_exits_when_parent_closes_connection(
+            self, tiny_bundle):
+        """Same no-leak contract over the network transport: a dialed-
+        back worker whose socket EOFs (parent gone, or a fence closing
+        the transport under a remote worker) exits 3 on its own."""
+        from dalle_pytorch_tpu.serve import worker as worker_mod
+        params, cfg = tiny_bundle
+        spec = {"index": 0, "params": params, "cfg": cfg,
+                "engine_kwargs": {"num_slots": 2, "chunk_steps": 4},
+                "device_index": 0, "place": False,
+                "heartbeat_interval_s": 0.05, "rss_limit_mb": 0,
+                "faults": None, "idle_sleep_s": 0.002}
+        listener = T.WorkerListener("127.0.0.1", 0)
+        ctx = mp.get_context("spawn")
+        proc = ctx.Process(
+            target=worker_mod.worker_main_dial,
+            args=("127.0.0.1", listener.port, listener.token, 0),
+            daemon=True)
+        try:
+            listener.expect(0, pickle.dumps(spec))
+            proc.start()
+            deadline = time.perf_counter() + 120
+            conn = None
+            while conn is None and time.perf_counter() < deadline:
+                conn = listener.take(0)
+                time.sleep(0.02)
+            assert conn is not None, "worker never attached"
+            ready = False
+            deadline = time.perf_counter() + 120
+            while time.perf_counter() < deadline:
+                if conn.poll(0.1):
+                    kind, _, _ = ipc.decode_frame(conn.recv_bytes())
+                    if kind == ipc.READY:
+                        ready = True
+                        break
+            assert ready, "worker never came up over the socket"
+            conn.close()                # the parent "dies"
+            proc.join(30)
+            assert proc.exitcode == 3, \
+                f"worker leaked (exitcode={proc.exitcode})"
+        finally:
+            listener.close()
+            if proc.is_alive():
+                proc.kill()
+
+    def test_wrong_token_worker_exits_rejected(self):
+        """A worker dialing with a bad token is turned away at HELLO
+        and exits 4 — it never gets a spec, never touches a replica."""
+        from dalle_pytorch_tpu.serve import worker as worker_mod
+        listener = T.WorkerListener("127.0.0.1", 0,
+                                    handshake_timeout_s=5.0)
+        ctx = mp.get_context("spawn")
+        proc = ctx.Process(
+            target=worker_mod.worker_main_dial,
+            args=("127.0.0.1", listener.port, "not-the-token", 0),
+            daemon=True)
+        try:
+            proc.start()
+            proc.join(60)
+            assert proc.exitcode == worker_mod.REJECTED_EXIT, \
+                f"exitcode={proc.exitcode}"
+        finally:
+            listener.close()
+            if proc.is_alive():
+                proc.kill()
