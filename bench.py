@@ -1846,6 +1846,152 @@ def _serve_transport_compare(params, cfg, *, replicas, num_slots, n_req,
     return out
 
 
+def _serve_mesh_compare(params, cfg, *, mesh_devices, num_slots, n_req,
+                        kv, page_size, chunk_steps=8):
+    """The mesh-sharded engine record (docs/SERVING.md 'Mesh-sharded
+    engine'), three asserted halves:
+
+      * EQUALITY — a fixed seeded burst through the single-device
+        engine and the mesh engine must emit byte-identical tokens
+        (the partition rules shard no contracted dim, so this is a
+        construction guarantee; the bench re-proves it on every run);
+      * TAX — single-device vs mesh ms/token at the same offered load
+        (the per-layer all-gathers are the cost of fitting at all;
+        report-only — on virtual CPU devices the collectives are
+        memcpy theater, on real ICI they are the honest number);
+      * HBM BUDGET — a modeled per-device budget is chosen BETWEEN the
+        config's single-device residency (params + KV pool) and its
+        per-shard residency: the config provably does NOT fit one
+        device under that budget, DOES fit each mesh shard, and the
+        mesh engine then actually serves the full burst with exactly
+        one decode compile and zero losses. That is the serving-scale
+        claim — models too big for one chip serve from one logical
+        engine — in asserted form.
+    """
+    import jax
+    import numpy as np
+
+    from dalle_pytorch_tpu.serve import Request, RequestQueue, \
+        SamplingParams
+    from dalle_pytorch_tpu.serve.engine import Engine
+    from dalle_pytorch_tpu.serve.mesh_engine import MeshEngine, hbm_report
+    from dalle_pytorch_tpu.parallel import serve_specs as SS
+
+    devices = jax.devices()
+    if len(devices) < mesh_devices:
+        raise AssertionError(
+            f"--serve_mesh {mesh_devices} needs that many devices, "
+            f"have {len(devices)}")
+    prompt_len = min(4, cfg.text_seq_len)
+    n_load = max(n_req, 2 * num_slots)
+    tokens_per_req = cfg.seq_len - prompt_len
+    out = {"mesh_devices": mesh_devices, "requests": n_load,
+           "tokens_per_request": tokens_per_req}
+
+    def build(mesh):
+        queue = RequestQueue(max_depth=max(4 * n_load, 16))
+        kw = dict(num_slots=num_slots, chunk_steps=chunk_steps, kv=kv,
+                  page_size=page_size if kv == "paged" else 0)
+        if mesh:
+            eng = MeshEngine(params, cfg, queue,
+                             devices=SS.slice_devices(
+                                 devices, 0, mesh_devices), **kw)
+        else:
+            eng = Engine(params, cfg, queue, **kw)
+        return eng, queue
+
+    # equality burst: same seeds/knobs through both engines, tokens
+    # byte-identical — the acceptance criterion, re-proved per run
+    n_eq = 4
+    tokens = {}
+    for mesh in (False, True):
+        eng, queue = build(mesh)
+        handles = [queue.submit(Request(
+            codes=(1 + i % 5,) * prompt_len, seed=i,
+            sampling=SamplingParams())) for i in range(n_eq)]
+        eng.run_until_idle()
+        results = [h.result(timeout=300) for h in handles]
+        bad = [r for r in results if r.status != "ok"]
+        if bad:
+            # a failed request must surface as ITSELF, not masquerade
+            # as a byte-identity mismatch of a None token array
+            raise AssertionError(
+                f"mesh={mesh}: equality burst had non-ok results: "
+                f"{[(r.status, r.reason) for r in bad]}")
+        tokens[mesh] = [np.asarray(r.tokens) for r in results]
+    mismatches = sum(not np.array_equal(a, b)
+                     for a, b in zip(tokens[False], tokens[True]))
+    out["token_mismatches"] = mismatches
+    if mismatches:
+        raise AssertionError(
+            f"mesh tokens diverged from single-device on "
+            f"{mismatches}/{n_eq} requests — the no-sharded-"
+            f"contraction byte-identity contract broke")
+
+    # tax legs: one load point each, same offered load, single-threaded
+    # drive, one-compile asserted
+    for mesh in (False, True):
+        eng, queue = build(mesh)
+        warm = queue.submit(Request(codes=(1,) * prompt_len, seed=0,
+                                    sampling=SamplingParams()))
+        eng.run_until_idle()
+        warm.result(timeout=300)
+        point = _serve_load_point(eng, queue, 1000.0, n_load, prompt_len)
+        if point["completed"] != n_load:
+            raise AssertionError(
+                f"mesh={mesh}: only {point['completed']}/{n_load} "
+                f"completed")
+        if eng.decode_traces != 1:
+            raise AssertionError(
+                f"mesh={mesh}: decode compiled {eng.decode_traces} "
+                f"times — the one-compile contract broke")
+        leg = {
+            "ms_per_token": round(
+                1e3 / max(point["tokens_per_s"], 1e-9), 4),
+            "throughput_imgs_per_s": point["throughput_imgs_per_s"],
+            "decode_compiles": eng.decode_traces,
+            "hbm": hbm_report(eng),
+        }
+        out["mesh" if mesh else "single"] = leg
+    single_ms = out["single"]["ms_per_token"]
+    out["mesh_tax_pct"] = round(
+        100.0 * (out["mesh"]["ms_per_token"] - single_ms)
+        / max(single_ms, 1e-9), 1)
+
+    # HBM-budget leg: pick the per-device budget between the modeled
+    # single-device residency and the per-shard residency — the config
+    # does NOT fit one device, DOES fit each shard — then serve the
+    # full burst from the mesh under it
+    hbm = out["mesh"]["hbm"]
+    if not (hbm["total_bytes_per_shard"] < hbm["total_bytes"]):
+        raise AssertionError(
+            f"mesh sharded nothing: per-shard {hbm} — heads/depth "
+            f"must divide the mesh for the budget leg to mean anything")
+    budget = (hbm["total_bytes"] + hbm["total_bytes_per_shard"]) // 2
+    out["hbm_budget"] = {
+        "device_budget_bytes": int(budget),
+        "single_device_bytes": hbm["total_bytes"],
+        "per_shard_bytes": hbm["total_bytes_per_shard"],
+        "fits_single_device": hbm["total_bytes"] <= budget,
+        "fits_mesh_shard": hbm["total_bytes_per_shard"] <= budget,
+    }
+    assert not out["hbm_budget"]["fits_single_device"]
+    assert out["hbm_budget"]["fits_mesh_shard"]
+    eng, queue = build(True)
+    handles = [queue.submit(Request(codes=(1 + i % 7,) * prompt_len,
+                                    seed=i, sampling=SamplingParams()))
+               for i in range(n_load)]
+    eng.run_until_idle()
+    ok = sum(h.result(timeout=300).status == "ok" for h in handles)
+    out["hbm_budget"]["completed"] = ok
+    out["hbm_budget"]["decode_compiles"] = eng.decode_traces
+    if ok != n_load or eng.decode_traces != 1:
+        raise AssertionError(
+            f"HBM-budget leg broke: {ok}/{n_load} completed, "
+            f"{eng.decode_traces} decode compiles")
+    return out
+
+
 def bench_serve(args):
     """Serving-path bench: the continuous-batching engine
     (dalle_pytorch_tpu/serve) under an offered-load sweep, swept over the
@@ -2022,6 +2168,20 @@ def bench_serve(args):
             isolation_compare = {"error": f"{type(e).__name__}: {e}"}
             errors.append(str(e))
 
+    mesh_compare = None
+    if args.serve_mesh > 1:
+        _progress(f"serve: single-device vs {args.serve_mesh}-device "
+                  f"mesh comparison + HBM-budget leg")
+        try:
+            mesh_compare = _serve_mesh_compare(
+                params, cfg, mesh_devices=args.serve_mesh,
+                num_slots=num_slots, n_req=n_req, kv=kv,
+                page_size=page_size)
+        except Exception as e:  # noqa: BLE001 — structured-error
+            # contract: the serve-mesh CI smoke greps for it
+            mesh_compare = {"error": f"{type(e).__name__}: {e}"}
+            errors.append(str(e))
+
     transport_compare = None
     if args.replicas > 1 and args.isolation == "process" \
             and args.transport == "socket":
@@ -2053,6 +2213,8 @@ def bench_serve(args):
         "paged_attn_compare": pa_compare,
         "devices": len(jax.devices()), "backend": jax.default_backend(),
     }
+    if mesh_compare is not None:
+        record["mesh_compare"] = mesh_compare
     if replica_compare is not None:
         record["replica_compare"] = replica_compare
     if isolation_compare is not None:
@@ -2171,6 +2333,16 @@ def main():
                     help="bench_serve: KV page size for paged engines "
                          "(0 = 8 rows under --tiny so pages divide the "
                          "tiny seq exactly, else 16)")
+    ap.add_argument("--serve_mesh", type=int, default=0,
+                    help="bench_serve: also run the mesh_compare "
+                         "record at this many devices per engine — "
+                         "byte-identical tokens single-vs-mesh "
+                         "asserted, ms/token both legs, and the "
+                         "HBM-budget leg: a modeled per-device budget "
+                         "the config does NOT fit on one device but "
+                         "DOES fit per mesh shard, served end-to-end "
+                         "with one decode compile and zero losses "
+                         "(docs/SERVING.md 'Mesh-sharded engine')")
     ap.add_argument("--replicas", type=int, default=1,
                     help="bench_serve: also run the replica-set "
                          "comparison at this many supervised engines "

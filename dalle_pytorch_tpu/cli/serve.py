@@ -104,6 +104,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "survivor with bit-identical tokens — zero "
                         "requests lost (docs/SERVING.md 'Replica set & "
                         "failover')")
+    p.add_argument("--mesh_devices", type=int, default=1,
+                   help="devices per engine: >1 serves ONE logical "
+                        "engine pjit-sharded over an ICI mesh slice of "
+                        "that many chips — params shard by depth, the "
+                        "KV pool by heads, tokens stay byte-identical "
+                        "to the single-chip engine — so a model whose "
+                        "params + KV pool exceed one device's HBM "
+                        "still serves. Composes with --replicas: each "
+                        "replica becomes a mesh SLICE (replica i gets "
+                        "devices [i*m, (i+1)*m)), and failover/replay "
+                        "carry over unchanged (docs/SERVING.md "
+                        "'Mesh-sharded engine')")
+    p.add_argument("--worker_ckpt", type=str, default=None,
+                   help="socket transport: attach spec carries this "
+                        "CHECKPOINT PATH instead of pickled params — "
+                        "each worker loads + validates it locally "
+                        "(checkpoint.validate; 'latest:<models_dir>:"
+                        "<name>' resolves the newest valid epoch), so "
+                        "weights never cross the wire and a remote "
+                        "host serves from its own checkpoint store. "
+                        "An invalid/missing checkpoint is a typed "
+                        "worker death (exit 5) on /healthz, not a "
+                        "crash to diff")
     p.add_argument("--isolation", choices=("thread", "process"),
                    default="thread",
                    help="replica isolation (replicas > 1): 'thread' = "
@@ -238,6 +261,12 @@ def main(argv=None):
         except ValueError:
             raise SystemExit(f"--prefill_buckets must be comma-separated "
                              f"ints, got {args.prefill_buckets!r}")
+    if args.worker_ckpt and (args.use_ema or args.quantize != "none"):
+        # the worker loads the RAW checkpoint; silently serving
+        # different weights per worker would be a correctness bug
+        raise SystemExit("--worker_ckpt serves the checkpoint's stored "
+                         "weights as-is; it does not compose with "
+                         "--use_ema or --quantize yet")
     server = InferenceServer(
         params, vae_params, cfg, num_slots=args.num_slots,
         queue_depth=args.queue_depth, chunk_steps=args.chunk_steps,
@@ -245,11 +274,13 @@ def main(argv=None):
         quantize_cache=args.quantize == "int8_kv",
         kv=args.kv, page_size=args.page_size, num_pages=args.num_pages,
         paged_attn=args.paged_attn,
-        replicas=args.replicas, heartbeat_s=args.heartbeat_s,
+        replicas=args.replicas, mesh_devices=args.mesh_devices,
+        heartbeat_s=args.heartbeat_s,
         isolation=args.isolation,
         child_rss_limit_mb=args.child_rss_limit_mb,
         transport=args.transport, worker_endpoint=args.worker_endpoint,
         worker_cmd=args.worker_cmd, attach_token=args.attach_token,
+        worker_ckpt=args.worker_ckpt,
         clip_params=clip_params, clip_cfg=clip_cfg, metrics=metrics,
         log_every=args.log_every, encode=vocab.encode,
         init_deadline_s=args.init_deadline_s,
@@ -258,8 +289,10 @@ def main(argv=None):
         else f"{args.kv}/{args.paged_attn}"
     iso_desc = args.isolation if args.transport == "pipe" \
         else f"{args.isolation}/{args.transport}"
+    mesh_desc = "" if args.mesh_devices <= 1 \
+        else f" x {args.mesh_devices}-device mesh"
     say(f"serving {dalle_path} on http://{args.host}:{args.port} "
-        f"({args.replicas} {iso_desc} replica(s) x "
+        f"({args.replicas} {iso_desc} replica(s){mesh_desc} x "
         f"{args.num_slots} slots, K={args.chunk_steps}, kv={kv_desc}, "
         f"queue {args.queue_depth})")
     if args.transport == "socket" and args.replicas > 1:

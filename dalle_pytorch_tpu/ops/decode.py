@@ -163,11 +163,18 @@ def _sparse_layout(cfg, total_len: int) -> Array:
     return jnp.asarray(np.asarray(layout)[:total_len, :total_len])
 
 
-def _attn_with_kv(lp: dict, h: Array, allowed: Array, cfg
-                  ) -> Tuple[Array, Array, Array]:
+def _attn_with_kv(lp: dict, h: Array, allowed: Array, cfg,
+                  out_sync=None) -> Tuple[Array, Array, Array]:
     """PreNorm attention over an explicit allowed-mask; returns out, k, v.
 
     h: (b, n, dim); allowed: broadcastable to (b, 1, n, n) (True = attend).
+    ``out_sync`` is the same mesh seam as ``_decode_step_math``'s: the
+    per-head output re-replicated before the out projection, so GSPMD
+    can never partial-sum the projection's contraction across head
+    shards (prefill writes a heads-sharded cache under the mesh engine,
+    and an unconstrained partitioner choice upstream of that output
+    would reassociate floats — byte-identity must not rest on a cost
+    model's mood).
     """
     p = lp["attn"]
     hn = core.layernorm(p["ln"], h)
@@ -175,13 +182,16 @@ def _attn_with_kv(lp: dict, h: Array, allowed: Array, cfg
     dots = jnp.einsum("bhid,bhjd->bhij", q, k) * cfg.scale
     dots = jnp.where(allowed, dots, core.neg_inf(dots.dtype))
     out = jnp.einsum("bhij,bhjd->bhid", jax.nn.softmax(dots, axis=-1), v)
+    if out_sync is not None:
+        out = out_sync(out)
     out = attn_ops.output_tail(p, out)
     return out, k, v
 
 
 def prefill(params: dict, x: Array, *, cfg, total_len: int,
             prompt_mask: Optional[Array] = None,
-            quantize_cache: bool = False) -> Tuple[Array, dict]:
+            quantize_cache: bool = False,
+            out_sync=None) -> Tuple[Array, dict]:
     """Run the prompt embeddings x (b, t0, dim) through the stack.
 
     Returns (h_out (b, t0, dim), cache with rows [0, t0) filled).
@@ -210,12 +220,12 @@ def prefill(params: dict, x: Array, *, cfg, total_len: int,
             if any_sparse else dense_allowed
         if cfg.reversible:
             x1, x2 = carry
-            a, k, v = _attn_with_kv(lp, x2, allowed, cfg)
+            a, k, v = _attn_with_kv(lp, x2, allowed, cfg, out_sync)
             y1 = x1 + a
             y2 = x2 + T.ff_or_moe(lp, y1, cfg, None, False)[0]
             return (y1, y2), (k, v)
         h = carry
-        a, k, v = _attn_with_kv(lp, h, allowed, cfg)
+        a, k, v = _attn_with_kv(lp, h, allowed, cfg, out_sync)
         h = h + a
         h = h + T.ff_or_moe(lp, h, cfg, None, False)[0]
         return h, (k, v)
@@ -231,8 +241,9 @@ def prefill(params: dict, x: Array, *, cfg, total_len: int,
 
 def decode_loop(params: dict, cur_tok: Array, pos: Array, active: Array,
                 cache: dict, *, cfg, key_mask: Array, steps: int,
-                embed_fn, sample_fn) -> Tuple[Array, Array, Array, dict,
-                                              Array]:
+                embed_fn, sample_fn,
+                out_sync=None) -> Tuple[Array, Array, Array, dict,
+                                        Array]:
     """Fuse ``steps`` decode steps into ONE device program: a ``lax.scan``
     over ``decode_step`` that carries (cur_tok, pos, active, cache) as
     device state and stacks each step's emitted token into an emit ring —
@@ -262,7 +273,7 @@ def decode_loop(params: dict, cur_tok: Array, pos: Array, active: Array,
         emit = jnp.where(act, cur_tok, -1)
         x = embed_fn(cur_tok, pos)
         h, cache = decode_step(params, x, pos, cache, cfg=cfg,
-                               key_mask=key_mask)
+                               key_mask=key_mask, out_sync=out_sync)
         nxt = sample_fn(h, pos + 1)
         pos = pos + 1
         act = act & (pos < total_len)
@@ -279,7 +290,7 @@ def decode_loop(params: dict, cur_tok: Array, pos: Array, active: Array,
 
 
 def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,
-                key_mask: Array) -> Tuple[Array, dict]:
+                key_mask: Array, out_sync=None) -> Tuple[Array, dict]:
     """Advance one token. x_tok: (b, dim) embedding of the token at position
     ``pos`` (traced scalar, or a (b,) vector of PER-ROW positions — the
     serve engine's continuous-batching step, where each slot of the fixed
@@ -290,14 +301,14 @@ def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,
     Returns (h_out (b, dim), updated cache).
     """
     h_out, ks, vs = _decode_step_math(params, x_tok, pos, cache, cfg=cfg,
-                                      key_mask=key_mask)
+                                      key_mask=key_mask, out_sync=out_sync)
     return h_out, _store_rows(cache, ks, vs, pos)
 
 
 def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
                       *, cfg, key_mask: Array, attn_impl: str = "gather",
-                      block_tables: Optional[Array] = None
-                      ) -> Tuple[Array, Array, Array]:
+                      block_tables: Optional[Array] = None,
+                      out_sync=None) -> Tuple[Array, Array, Array]:
     """The read half of ``decode_step``: attention over the cached rows
     plus self, WITHOUT the cache write-back. Returns (h_out (b, dim),
     new ks, new vs (depth, b, heads, 1, dh)) so the two cache layouts —
@@ -382,6 +393,13 @@ def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
                    + w_self[..., None] * v[:, :, 0, :]
                    .astype(jnp.float32)) / denom[..., None]
             out = out.astype(q.dtype)[:, :, None, :]
+            if out_sync is not None:
+                # mesh-sharded serving (parallel/serve_specs.py): the
+                # per-head output is re-replicated HERE, so the out
+                # projection sees gathered heads (data movement) and
+                # never partial-sums its contraction across shards —
+                # the byte-identity contract's load-bearing constraint
+                out = out_sync(out)
             return attn_ops.output_tail(p, out), k, v
         # int8 cache: XLA reads int8 rows from HBM, upcasts in registers,
         # and the per-row scales apply OUTSIDE the contractions (along j),
@@ -404,6 +422,10 @@ def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
         else:
             cvc = cv
         out = jnp.einsum("bhqj,bhjd->bhqd", wj, cvc) + w[..., -1:] * v
+        if out_sync is not None:
+            # see the kernel branch above: gather heads before the out
+            # projection instead of letting GSPMD partial-sum it
+            out = out_sync(out)
         return attn_ops.output_tail(p, out), k, v
 
     def body(carry, xs):
@@ -541,7 +563,8 @@ def _store_rows_paged(pool: dict, ks: Array, vs: Array, pos: Array,
 def decode_step_paged(params: dict, x_tok: Array, pos: Array, pool: dict,
                       block_tables: Array, *, cfg, key_mask: Array,
                       total_len: int, active: Array,
-                      attn_impl: str = "gather") -> Tuple[Array, dict]:
+                      attn_impl: str = "gather",
+                      out_sync=None) -> Tuple[Array, dict]:
     """``decode_step`` against the paged pool. ``attn_impl='gather'``
     (default, the parity oracle) gathers the dense view through the
     block tables and runs the one shared step math — token-exact with
@@ -555,18 +578,21 @@ def decode_step_paged(params: dict, x_tok: Array, pos: Array, pool: dict,
     if attn_impl == "kernel":
         h_out, ks, vs = _decode_step_math(
             params, x_tok, pos, pool, cfg=cfg, key_mask=key_mask,
-            attn_impl="kernel", block_tables=block_tables)
+            attn_impl="kernel", block_tables=block_tables,
+            out_sync=out_sync)
     else:
         view = paged_view(pool, block_tables, total_len)
         h_out, ks, vs = _decode_step_math(params, x_tok, pos, view,
-                                          cfg=cfg, key_mask=key_mask)
+                                          cfg=cfg, key_mask=key_mask,
+                                          out_sync=out_sync)
     return h_out, _store_rows_paged(pool, ks, vs, pos, block_tables, active)
 
 
 def decode_loop_paged(params: dict, cur_tok: Array, pos: Array,
                       active: Array, pool: dict, block_tables: Array, *,
                       cfg, key_mask: Array, total_len: int, steps: int,
-                      embed_fn, sample_fn, attn_impl: str = "gather"
+                      embed_fn, sample_fn, attn_impl: str = "gather",
+                      out_sync=None
                       ) -> Tuple[Array, Array, Array, dict, Array]:
     """``decode_loop`` over the paged pool: the same one-compile fused
     K-step scan and emit-ring contract, with (cur_tok, pos, active, pool)
@@ -586,7 +612,8 @@ def decode_loop_paged(params: dict, cur_tok: Array, pos: Array,
         h, pool = decode_step_paged(params, x, pos, pool, block_tables,
                                     cfg=cfg, key_mask=key_mask,
                                     total_len=total_len, active=act,
-                                    attn_impl=attn_impl)
+                                    attn_impl=attn_impl,
+                                    out_sync=out_sync)
         nxt = sample_fn(h, pos + 1)
         pos = pos + 1
         act = act & (pos < total_len)

@@ -17,4 +17,6 @@ from dalle_pytorch_tpu.parallel.ring import (  # noqa: F401
     ring_attention, ulysses_attention)
 from dalle_pytorch_tpu.parallel.sequence import (  # noqa: F401
     sp_dalle_loss_fn, sp_transformer_apply)
+from dalle_pytorch_tpu.parallel.serve_specs import (  # noqa: F401
+    serve_kv_specs, serve_mesh, serve_param_specs, slice_devices)
 from dalle_pytorch_tpu.parallel.train import make_train_step  # noqa: F401

@@ -24,6 +24,9 @@ def __getattr__(name):
     if name == "ReplicaSet":
         from dalle_pytorch_tpu.serve.replica import ReplicaSet
         return ReplicaSet
+    if name == "MeshEngine":
+        from dalle_pytorch_tpu.serve.mesh_engine import MeshEngine
+        return MeshEngine
     if name == "PostProcessor":
         from dalle_pytorch_tpu.serve.postprocess import PostProcessor
         return PostProcessor

@@ -152,11 +152,14 @@ class Engine:
         # ONE device, so a ReplicaSet can put each replica on its own
         # chip and their chunk programs genuinely overlap. device=None
         # (the single-engine default) keeps jax's default placement.
+        # Placement flows through the _place_*/_put hooks so a subclass
+        # can swap "one device" for "one mesh" (serve/mesh_engine.py's
+        # MeshEngine: params/KV pjit-sharded, host-visible state
+        # replicated) without touching any of the loop's logic.
         self.device = device
-        if device is not None:
-            params = jax.device_put(params, device)
-        self.params = params
         self.cfg = cfg
+        self.params = self._place_params(params)
+        params = self.params
         self.queue = queue
         self.num_slots = int(num_slots)
         self.chunk_steps = int(chunk_steps)
@@ -230,16 +233,16 @@ class Engine:
                     f"num_pages={self.num_pages} cannot hold even one "
                     f"full sequence ({self.slot_max_pages} pages of "
                     f"{self.page_size} rows + the reserved trash page)")
-            self.cache = KV.init_page_pool(
+            self.cache = self._place_kv(KV.init_page_pool(
                 cfg.transformer, self.num_pages, self.page_size,
                 dtype=params["text_emb"]["w"].dtype,
-                quantized=self.quantize_cache)
+                quantized=self.quantize_cache))
             self.alloc = KV.PageAllocator(self.num_pages)
             # the host owns the authoritative block tables (it owns the
             # allocator); the device copy is pushed — one explicit
             # device_put of a few KB — only when the mapping changes
             self._bt_host = np.zeros((S_, self.slot_max_pages), np.int32)
-            self.block_tables = jax.device_put(self._bt_host)
+            self.block_tables = self._put(self._bt_host)
             self._bt_dirty = False
             self._slot_pages: List[List[int]] = [[] for _ in range(S_)]
             # safe host-side upper bound of each slot's device pos
@@ -264,30 +267,24 @@ class Engine:
             self._min_admit_pages = KV.pages_for(min(self.buckets),
                                                  self.page_size)
         else:
-            self.cache = decode_ops.init_cache(
+            self.cache = self._place_kv(decode_ops.init_cache(
                 cfg.transformer, S_, self.total_len,
                 dtype=params["text_emb"]["w"].dtype,
-                quantized=self.quantize_cache)
-        self.key_mask = jnp.ones((S_, self.total_len), bool)
-        self.cur_tok = jnp.zeros((S_,), jnp.int32)
-        self.pos = jnp.zeros((S_,), jnp.int32)
-        self.active = jnp.zeros((S_,), bool)
-        self.rng = jnp.zeros((S_, 2), jnp.uint32)
-        self.temp = jnp.ones((S_,), jnp.float32)
-        self.topk_k = jnp.ones((S_,), jnp.int32)
-        self.top_p = jnp.zeros((S_,), jnp.float32)
-        if device is not None:
-            # commit the pool + per-slot state too: nothing this engine
-            # carries between chunks may sit on the default device for
-            # jit to migrate per call
-            (self.cache, self.key_mask, self.cur_tok, self.pos,
-             self.active, self.rng, self.temp, self.topk_k,
-             self.top_p) = jax.device_put(
-                (self.cache, self.key_mask, self.cur_tok, self.pos,
-                 self.active, self.rng, self.temp, self.topk_k,
-                 self.top_p), device)
-            if self.kv == "paged":
-                self.block_tables = jax.device_put(self._bt_host, device)
+                quantized=self.quantize_cache))
+        # commit the per-slot state too: nothing this engine carries
+        # between chunks may sit on the default device for jit to
+        # migrate per call (on a placed replica it lands on its chip;
+        # on a mesh engine it is replicated across the slice)
+        (self.key_mask, self.cur_tok, self.pos, self.active, self.rng,
+         self.temp, self.topk_k, self.top_p) = self._place_state((
+            jnp.ones((S_, self.total_len), bool),
+            jnp.zeros((S_,), jnp.int32),
+            jnp.zeros((S_,), jnp.int32),
+            jnp.zeros((S_,), bool),
+            jnp.zeros((S_, 2), jnp.uint32),
+            jnp.ones((S_,), jnp.float32),
+            jnp.ones((S_,), jnp.int32),
+            jnp.zeros((S_,), jnp.float32)))
         self.slots: List[Optional[_Slot]] = [None] * S_
         self._pending: deque = deque()   # dispatched, un-harvested chunks
 
@@ -340,10 +337,64 @@ class Engine:
         donate = donate_if_accelerator(1)
         impl = self._decode_impl_paged if self.kv == "paged" \
             else self._decode_impl
-        self._decode_fn = jax.jit(impl, donate_argnums=donate)
+        self._decode_fn = self._jit_decode(impl, donate)
         self._kill_fn = jax.jit(lambda active, keep: active & keep)
         self._prefill_fns: Dict = {}
         self._lock = threading.Lock()   # step_once is not reentrant
+
+    # -- placement hooks (the mesh seam: serve/mesh_engine.py) --------------
+    #
+    # Every host<->device placement the engine performs flows through
+    # these five methods, and the two jit hooks own program construction.
+    # The base implementations reproduce the single-device behaviour
+    # exactly; MeshEngine overrides them to pjit-shard params and the KV
+    # store over a device mesh while replicating everything the host
+    # protocol touches — which is why the entire serving loop above them
+    # (admission, fused chunks, emit-ring harvest, fencing, supervision)
+    # runs unmodified on a mesh.
+
+    def _put(self, a):
+        """One explicit host->device transfer of a small host array
+        (admission tensors, block tables, kill masks)."""
+        import jax
+        return jax.device_put(a, self.device)
+
+    def _place_params(self, params):
+        import jax
+        return params if self.device is None \
+            else jax.device_put(params, self.device)
+
+    def _place_kv(self, cache: dict) -> dict:
+        import jax
+        return cache if self.device is None \
+            else jax.device_put(cache, self.device)
+
+    def _place_state(self, state: tuple) -> tuple:
+        import jax
+        return state if self.device is None \
+            else jax.device_put(state, self.device)
+
+    def _jit_decode(self, impl, donate):
+        import jax
+        return jax.jit(impl, donate_argnums=donate)
+
+    def _jit_prefill_program(self, pre):
+        import jax
+        return jax.jit(pre)
+
+    def _logits_sync(self, logits):
+        """Traced hook over the per-step logits, identity here. The mesh
+        engine re-replicates here: its logits head is vocab-sharded
+        (column-parallel, elementwise-exact), and the sampler's softmax/
+        cumsum reductions must never run over a sharded axis or the
+        byte-identity contract dies to float reassociation."""
+        return logits
+
+    def _decode_out_sync(self):
+        """The ``ops.decode`` ``out_sync`` seam: None here; the mesh
+        engine returns a replicate-constraint applied to the per-head
+        attention output before the out projection."""
+        return None
 
     # -- jitted programs ----------------------------------------------------
 
@@ -362,14 +413,15 @@ class Engine:
             return D.decode_token_embed(params, self.cfg, tok, p)
 
         def sample_fn(h, pred_pos):
-            logits = D.to_logits(params, h)
+            logits = self._logits_sync(D.to_logits(params, h))
             return D.sample_per_slot(logits, pred_pos, keys, temp,
                                      topk_k, top_p, self.cfg)
 
         return decode_ops.decode_loop(
             params["transformer"], cur_tok, pos, active, cache,
             cfg=self.cfg.transformer, key_mask=self.key_mask,
-            steps=self.chunk_steps, embed_fn=embed_fn, sample_fn=sample_fn)
+            steps=self.chunk_steps, embed_fn=embed_fn, sample_fn=sample_fn,
+            out_sync=self._decode_out_sync())
 
     def _decode_impl_paged(self, params, cache, block_tables, cur_tok, pos,
                            active, keys, temp, topk_k, top_p):
@@ -389,7 +441,7 @@ class Engine:
             return D.decode_token_embed(params, self.cfg, tok, p)
 
         def sample_fn(h, pred_pos):
-            logits = D.to_logits(params, h)
+            logits = self._logits_sync(D.to_logits(params, h))
             return D.sample_per_slot(logits, pred_pos, keys, temp,
                                      topk_k, top_p, self.cfg)
 
@@ -398,7 +450,8 @@ class Engine:
             block_tables, cfg=self.cfg.transformer,
             key_mask=self.key_mask, total_len=self.total_len,
             steps=self.chunk_steps, embed_fn=embed_fn,
-            sample_fn=sample_fn, attn_impl=self.paged_attn)
+            sample_fn=sample_fn, attn_impl=self.paged_attn,
+            out_sync=self._decode_out_sync())
 
     def _prefill_fn(self, bucket: int):
         """Admission program for one prompt-length BUCKET: batched prefill
@@ -436,7 +489,8 @@ class Engine:
             h, group = decode_ops.prefill(
                 params["transformer"], tokens, cfg=self.cfg.transformer,
                 total_len=self.total_len, prompt_mask=None,
-                quantize_cache=self.quantize_cache)
+                quantize_cache=self.quantize_cache,
+                out_sync=self._decode_out_sync())
             if paged:
                 # scatter the group's [0, bucket) rows into their pages:
                 # row j of group-row g lands in physical page
@@ -464,7 +518,7 @@ class Engine:
             # identical to the unpadded prefill's last row
             h_last = jnp.take_along_axis(
                 h, (lens - 1)[:, None, None], axis=1)[:, 0]
-            logits = D.to_logits(params, h_last)
+            logits = self._logits_sync(D.to_logits(params, h_last))
             first = D.sample_per_slot(logits, lens, n_rng, n_temp,
                                       n_topk, n_top_p, self.cfg)
             cur_tok = cur_tok.at[slots].set(first, mode="drop")
@@ -476,7 +530,7 @@ class Engine:
             top_p = top_p.at[slots].set(n_top_p, mode="drop")
             return cache, cur_tok, pos, active, rng, temp, topk_k, top_p
 
-        fn = jax.jit(pre)
+        fn = self._jit_prefill_program(pre)
         self._prefill_fns[bucket] = fn
         return fn
 
@@ -602,7 +656,6 @@ class Engine:
             total_s=round(now - req.submit_t, 6)))
 
     def _admit(self, handles: List[S.RequestHandle], now: float) -> None:
-        import jax
         if self.fenced:
             # fenced mid-step after the pop: these handles are in
             # neither the queue nor a slot, so the reclaim sweep cannot
@@ -723,8 +776,9 @@ class Engine:
                 # host->device traffic is device_put at the site, never
                 # implicit conversion (guards.no_transfers-clean).
                 # device=None is jax's default placement; a placed
-                # replica ships straight to its own chip
-                put = lambda a: jax.device_put(a, self.device)  # noqa: E731
+                # replica ships straight to its own chip, a mesh engine
+                # replicates across its slice
+                put = self._put
                 cold = bucket not in self._prefill_fns
                 if cold:
                     self.compiling = True
@@ -806,7 +860,6 @@ class Engine:
         positions) replays its exact token stream, so eviction costs
         latency, never correctness. Returns False when no slot is
         active."""
-        import jax
         if self.fenced:
             return False    # the reclaim sweep owns every in-slot handle
         cand = [(s.handle.request.priority, s.t_admit, i)
@@ -819,8 +872,7 @@ class Engine:
         self._free_slot(i)
         keep = np.ones((self.num_slots,), bool)
         keep[i] = False
-        self.active = self._kill_fn(self.active,
-                                    jax.device_put(keep, self.device))
+        self.active = self._kill_fn(self.active, self._put(keep))
         self.evicted += 1
         # un-credit the victim's harvested tokens: re-admission replays
         # them all, so leaving the prefix counted would inflate
@@ -874,9 +926,8 @@ class Engine:
         """Push the host's authoritative block tables to the device when
         the mapping changed — ONE explicit device_put of a few KB, the
         only paged-specific host->device traffic in steady state."""
-        import jax
         if self._bt_dirty:
-            self.block_tables = jax.device_put(self._bt_host, self.device)
+            self.block_tables = self._put(self._bt_host)
             self._bt_dirty = False
 
     # -- the fused-chunk pipeline -------------------------------------------
@@ -992,7 +1043,6 @@ class Engine:
         and overlapped with the next chunk's compute. Tests pin the
         whole iteration (including a mid-stream join) under
         ``analysis.guards.no_transfers()``."""
-        import jax
         with self._lock:
             if self.fenced:
                 return False        # reclaimed: this pool is dead weight
@@ -1018,8 +1068,7 @@ class Engine:
             if kill:
                 keep = np.ones((self.num_slots,), bool)
                 keep[kill] = False
-                self.active = self._kill_fn(
-                    self.active, jax.device_put(keep, self.device))
+                self.active = self._kill_fn(self.active, self._put(keep))
                 did = True
 
             free = self.num_slots - self.active_slots()
@@ -1182,6 +1231,15 @@ class Engine:
         s = sorted(self._pages_samples)
         return s[min(int(0.95 * len(s)), len(s) - 1)]
 
+    def _mesh_stats(self) -> dict:
+        """The mesh-observability block /stats carries (mesh satellite):
+        a plain engine is one chip, and its whole KV store lives there.
+        ``MeshEngine`` overrides with its mesh shape and the per-SHARD
+        residency — where the pool actually lives."""
+        return {"devices_per_replica": 1,
+                "mesh_shape": None,
+                "kv_hbm_bytes_per_shard": self.kv_hbm_bytes()}
+
     def stats(self) -> dict:
         elapsed = None if self._t_start is None \
             else max(self.clock() - self._t_start, 1e-9)
@@ -1202,6 +1260,7 @@ class Engine:
         return {
             "kv": self.kv,
             "kv_hbm_bytes": self.kv_hbm_bytes(),
+            **self._mesh_stats(),
             **paged,
             "queue_depth": self.queue.depth(),
             "active_slots": self.active_slots(),

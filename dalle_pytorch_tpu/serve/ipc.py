@@ -115,6 +115,12 @@ HARVEST_BATCH = 8
 # operators read it the same way in either environment
 OOM_EXIT = 137
 
+# exit code for a worker whose LOCAL checkpoint (ckpt-path attach specs,
+# serve/worker.py) is missing or fails checkpoint.validate — a typed,
+# operator-actionable death distinct from a crash: fix the path / rsync
+# the checkpoint, the circuit breaker retries meanwhile
+BAD_CKPT_EXIT = 5
+
 
 def encode_frame(kind: str, payload: dict, seq: int = 0) -> bytes:
     body = json.dumps(payload, separators=(",", ":")).encode()
@@ -238,6 +244,8 @@ class ChildEngineClient:
                  engine_kwargs: dict,
                  device_index: int = 0,
                  place: bool = False,
+                 devices_per_replica: int = 1,
+                 ckpt_path: Optional[str] = None,
                  heartbeat_interval_s: float = 0.05,
                  rss_limit_mb: int = 0,
                  fault_plan: Optional[dict] = None,
@@ -256,13 +264,22 @@ class ChildEngineClient:
         self.kv = str(engine_kwargs.get("kv", "dense"))
         self.on_done = on_done
         self.transport_kind = str(transport)
+        if ckpt_path is None and params is None:
+            raise ValueError("ChildEngineClient needs params or a "
+                             "ckpt_path for the worker to load from")
         spec = {
             "index": self.index,
-            "params": params,              # numpy pytree (picklable)
+            # numpy pytree (picklable) — or, with ckpt_path, NOTHING:
+            # the worker loads + validates the checkpoint locally
+            # (serve/worker.py), and the attach spec shrinks from the
+            # weight pytree to a path string
+            "params": None if ckpt_path is not None else params,
+            "ckpt_path": ckpt_path,
             "cfg": cfg,
             "engine_kwargs": dict(engine_kwargs),
             "device_index": int(device_index),
             "place": bool(place),
+            "devices_per_replica": int(devices_per_replica),
             "heartbeat_interval_s": float(heartbeat_interval_s),
             "rss_limit_mb": int(rss_limit_mb),
             "faults": fault_plan,
@@ -592,6 +609,9 @@ class ChildEngineClient:
             return f"killed by {name}"
         if code == OOM_EXIT:
             return f"oom-killed (exit {OOM_EXIT}: child RSS limit)"
+        if code == BAD_CKPT_EXIT:
+            return (f"invalid checkpoint (exit {BAD_CKPT_EXIT}: the "
+                    f"worker's local checkpoint failed validation)")
         return f"exit code {code}"
 
     def exit_desc(self) -> str:

@@ -137,6 +137,31 @@ def pool_bytes(pool: dict) -> int:
     return int(sum(x.nbytes for x in pool.values()))
 
 
+def modeled_kv_bytes(cfg, *, kv: str, num_slots: int, total_len: int,
+                     page_size: int = 0, num_pages: int = 0,
+                     quantized: bool = False,
+                     dtype_bytes: int = 4) -> int:
+    """KV-store bytes from CONFIG alone — the same number
+    ``pool_bytes`` measures on a live engine's arrays, computable
+    without building one (the replica set's /stats for child-process
+    engines, whose pools live in another interpreter, and bench's
+    HBM-budget math read this). Mirrors the engine's defaults:
+    ``page_size`` 0 -> min(16, total_len); ``num_pages`` 0 -> fully
+    provisioned (num_slots full sequences + the trash page)."""
+    depth, heads, dh = cfg.depth, cfg.heads, cfg.dim_head
+    if kv == "paged":
+        ps = int(page_size) or min(16, total_len)
+        pages = int(num_pages) or \
+            num_slots * pages_for(total_len, ps) + 1
+        rows = pages * ps
+    else:
+        rows = num_slots * total_len
+    per_row = (1 + 4 / dh) if quantized else dtype_bytes
+    # k + v; quantized stores int8 rows (1 byte/elem) plus one f32
+    # scale per row — expressed per element as 1 + 4/dh
+    return int(2 * depth * heads * rows * dh * per_row)
+
+
 class PageAllocator:
     """Host-side free-list over physical pages ``[1, num_pages)`` (page 0
     is the reserved trash page). Single-threaded by design — the engine

@@ -185,7 +185,9 @@ class ReplicaSet:
                  transport: str = "pipe",
                  worker_endpoint: str = "127.0.0.1:0",
                  worker_cmd: Optional[str] = None,
-                 attach_token: Optional[str] = None):
+                 attach_token: Optional[str] = None,
+                 worker_ckpt: Optional[str] = None,
+                 devices_per_replica: int = 1):
         import jax
 
         from dalle_pytorch_tpu.resilience import faults
@@ -206,6 +208,25 @@ class ReplicaSet:
         if worker_cmd is not None and transport != "socket":
             raise ValueError("worker_cmd needs transport='socket' — a "
                              "pipe cannot cross a launcher boundary")
+        if worker_ckpt is not None and transport != "socket":
+            raise ValueError(
+                "worker_ckpt needs transport='socket': its point is "
+                "that a worker on ANOTHER host loads weights from its "
+                "local checkpoint store instead of receiving pickled "
+                "params over the wire")
+        self.devices_per_replica = int(devices_per_replica)
+        if self.devices_per_replica < 1:
+            raise ValueError(f"devices_per_replica must be >= 1, got "
+                             f"{devices_per_replica}")
+        if self.devices_per_replica > 1 and paged_attn == "kernel":
+            # fail at construction with the typed error, not once per
+            # circuit-broken bring-up attempt forever
+            from dalle_pytorch_tpu.serve.mesh_engine import \
+                MeshPagedAttnError
+            from dalle_pytorch_tpu.utils.metrics import structured_event
+            raise MeshPagedAttnError(structured_event(
+                "serve_mesh_paged_attn_unsupported",
+                paged_attn="kernel"))
         # the CLI-harness fault path (DALLE_FAULTS): child plans are cut
         # at spawn time, so the env plan must be live before the first
         # bring-up — no-op when unset or already active
@@ -239,14 +260,20 @@ class ReplicaSet:
             log_every=log_every, quantize_cache=quantize_cache,
             kv=kv, page_size=page_size, num_pages=num_pages,
             paged_attn=paged_attn)
+        self.worker_ckpt = worker_ckpt
         if self.isolation == "process":
             import numpy as np
             # what crosses the spawn boundary: a host numpy pytree of
             # the params (one device_get here, one upload in the child
             # — the child owns its own device copy), and a picklable
             # subset of the engine kwargs (the metrics sink stays in
-            # the parent; supervision events are parent-side)
-            self._np_params = jax.tree.map(np.asarray, params)
+            # the parent; supervision events are parent-side). With
+            # worker_ckpt set, NO params cross at all: the spec carries
+            # the checkpoint path and each worker loads + validates
+            # locally (serve/worker.py) — the attach spec shrinks from
+            # the full weight pytree to a string
+            self._np_params = None if worker_ckpt is not None \
+                else jax.tree.map(np.asarray, params)
             self._child_kwargs = dict(
                 num_slots=num_slots, chunk_steps=chunk_steps,
                 prefill_buckets=prefill_buckets,
@@ -274,7 +301,23 @@ class ReplicaSet:
         self._placed = place_on_devices and len(devices) > 1
         self.replicas: List[_Replica] = []
         for i in range(self.n_replicas):
-            dev = devices[i % len(devices)] if self._placed else None
+            if self.devices_per_replica > 1 \
+                    and self.isolation != "process":
+                # replica = mesh SLICE: devices [i*m, (i+1)*m) (wrapped
+                # like the single-chip i % n placement when the host
+                # holds fewer slices than replicas). A mesh engine is
+                # always pinned to its slice — unpinned, every replica
+                # would shard over ALL devices and serialize against
+                # the others. Process mode resolves the slice in the
+                # WORKER from its own jax client (serve/worker.py): a
+                # remote worker's devices live on its host, and the
+                # parent — possibly a 0-accelerator head node — must
+                # not gate construction on holding them locally.
+                from dalle_pytorch_tpu.parallel import serve_specs as SS
+                dev = SS.slice_devices(devices, i,
+                                       self.devices_per_replica)
+            else:
+                dev = devices[i % len(devices)] if self._placed else None
             self.replicas.append(_Replica(i, device=dev))
 
         # supervisor counters + retired-engine counter base: a fenced
@@ -329,6 +372,8 @@ class ReplicaSet:
                     engine_kwargs=self._child_kwargs,
                     device_index=r.index,
                     place=self._placed,
+                    devices_per_replica=self.devices_per_replica,
+                    ckpt_path=self.worker_ckpt,
                     heartbeat_interval_s=min(
                         max(self.heartbeat_s / 5, 0.01), 0.25),
                     rss_limit_mb=self.child_rss_limit_mb,
@@ -346,9 +391,22 @@ class ReplicaSet:
                 queue = S.RequestQueue(
                     max_depth=4 * self._engine_kwargs["num_slots"] + 8,
                     clock=self.clock)
-                engine = Engine(self.params, self.cfg, queue,
-                                complete=self.complete, clock=self.clock,
-                                device=r.device, **self._engine_kwargs)
+                if self.devices_per_replica > 1:
+                    # replica = mesh slice: same Engine surface, params
+                    # + KV sharded over this replica's device slice —
+                    # which is why nothing else in this module changes
+                    from dalle_pytorch_tpu.serve.mesh_engine import \
+                        MeshEngine
+                    engine = MeshEngine(
+                        self.params, self.cfg, queue,
+                        complete=self.complete, clock=self.clock,
+                        devices=r.device, **self._engine_kwargs)
+                else:
+                    engine = Engine(self.params, self.cfg, queue,
+                                    complete=self.complete,
+                                    clock=self.clock,
+                                    device=r.device,
+                                    **self._engine_kwargs)
         except Exception as e:  # noqa: BLE001 — circuit-break, don't die
             r.attempt += 1
             self.bringup_failures += 1
@@ -1054,7 +1112,38 @@ class ReplicaSet:
         return [r.engine.decode_traces for r in self.replicas
                 if r.engine is not None]
 
+    def _kv_bytes_per_shard(self) -> int:
+        """Per-shard KV residency — where one device of a replica's
+        slice actually holds the pool (/stats mesh satellite). Read off
+        a live thread-mode engine; MODELED from config for child-process
+        engines, whose pools live in other interpreters."""
+        if self.isolation != "process":
+            for r in self.replicas:
+                if r.engine is not None:
+                    return r.engine._mesh_stats()[
+                        "kv_hbm_bytes_per_shard"]
+        from dalle_pytorch_tpu.serve import kv_pool as KV
+        kw = self._engine_kwargs
+        try:
+            dtype_bytes = self.params["text_emb"]["w"].dtype.itemsize
+        except (TypeError, KeyError, AttributeError):
+            dtype_bytes = 4     # worker_ckpt mode may carry no params
+        total = KV.modeled_kv_bytes(
+            self.cfg.transformer, kv=self.kv,
+            num_slots=kw["num_slots"], total_len=self.cfg.seq_len,
+            page_size=kw["page_size"], num_pages=kw["num_pages"],
+            quantized=kw["quantize_cache"], dtype_bytes=dtype_bytes)
+        from dalle_pytorch_tpu.parallel.serve_specs import kv_heads_shard
+        m = self.devices_per_replica
+        if m > 1 and kv_heads_shard(self.cfg.transformer.heads, m):
+            return total // m   # heads-sharded pool divides exactly
+        return total
+
     def stats(self) -> dict:
+        # lazy (the serve package's jax-free-import discipline):
+        # serve_specs pulls jax, and by stats() time a backend exists
+        from dalle_pytorch_tpu.parallel.serve_specs import \
+            SERVE_AXIS as _SERVE_AXIS
         elapsed = None if self._t_start is None \
             else max(self.clock() - self._t_start, 1e-9)
         live = [r for r in self.replicas if r.engine is not None]
@@ -1096,6 +1185,13 @@ class ReplicaSet:
         out = {
             "replicas": self.n_replicas,
             "isolation": self.isolation,
+            # mesh observability (/stats satellite): how many devices
+            # each replica's engine spans, and the mesh shape when > 1
+            "devices_per_replica": self.devices_per_replica,
+            "mesh_shape": (
+                {_SERVE_AXIS: self.devices_per_replica}
+                if self.devices_per_replica > 1 else None),
+            "kv_hbm_bytes_per_shard": self._kv_bytes_per_shard(),
             "alive_replicas": sum(
                 1 for r in self.replicas
                 if r.state == RUNNING and r.engine is not None),

@@ -64,6 +64,7 @@ class InferenceServer:
                  num_pages: int = 0,
                  paged_attn: str = "gather",
                  replicas: int = 1,
+                 mesh_devices: int = 1,
                  heartbeat_s: float = 5.0,
                  isolation: str = "thread",
                  child_rss_limit_mb: int = 0,
@@ -71,6 +72,7 @@ class InferenceServer:
                  worker_endpoint: str = "127.0.0.1:0",
                  worker_cmd: Optional[str] = None,
                  attach_token: Optional[str] = None,
+                 worker_ckpt: Optional[str] = None,
                  clip_params: Optional[dict] = None, clip_cfg=None,
                  decode_images: bool = True,
                  metrics=None, log_every: int = 50,
@@ -82,6 +84,19 @@ class InferenceServer:
         self.init_deadline_s = init_deadline_s
         self.init_retries = init_retries
         self.replicas = int(replicas)
+        self.mesh_devices = int(mesh_devices)
+        if self.mesh_devices < 1:
+            raise ValueError(f"mesh_devices must be >= 1, got "
+                             f"{mesh_devices}")
+        if worker_ckpt is not None and transport != "socket":
+            # same silent-misconfiguration hazard as worker_cmd: the
+            # operator believes workers load locally when they don't.
+            # (socket itself already implies process isolation and
+            # replicas >= 2 via the checks below)
+            raise ValueError(
+                "worker_ckpt requires transport='socket' — its point "
+                "is that a worker loads the checkpoint from its OWN "
+                "host's store instead of receiving params over a pipe")
         if isolation == "process" and self.replicas < 2:
             # process isolation exists to keep the SET alive through a
             # child death; a 1-replica process set is legal for the
@@ -129,7 +144,28 @@ class InferenceServer:
                 heartbeat_s=heartbeat_s, isolation=isolation,
                 child_rss_limit_mb=child_rss_limit_mb,
                 transport=transport, worker_endpoint=worker_endpoint,
-                worker_cmd=worker_cmd, attach_token=attach_token)
+                worker_cmd=worker_cmd, attach_token=attach_token,
+                worker_ckpt=worker_ckpt,
+                devices_per_replica=self.mesh_devices)
+        elif self.mesh_devices > 1:
+            # ONE logical engine pjit-sharded over a device mesh — the
+            # serve surface is identical (docs/SERVING.md 'Mesh-sharded
+            # engine'), so the single-engine thread loop below drives it
+            # unchanged
+            import jax
+
+            from dalle_pytorch_tpu.serve.mesh_engine import MeshEngine
+            from dalle_pytorch_tpu.parallel import serve_specs as SS
+            self.engine = MeshEngine(
+                params, cfg, self.queue,
+                devices=SS.slice_devices(jax.devices(), 0,
+                                         self.mesh_devices),
+                num_slots=num_slots,
+                chunk_steps=chunk_steps, prefill_buckets=prefill_buckets,
+                complete=self._on_decoded, metrics=metrics,
+                log_every=log_every, quantize_cache=quantize_cache,
+                kv=kv, page_size=page_size, num_pages=num_pages,
+                paged_attn=paged_attn)
         else:
             self.engine = engine_mod.Engine(
                 params, cfg, self.queue, num_slots=num_slots,
@@ -272,7 +308,13 @@ class InferenceServer:
         per-replica state (``running``/``broken``/``drained``,
         heartbeat age) — ``ok`` is False (HTTP 503) only when EVERY
         replica is dead."""
-        out = {"ok": self.engine_alive()}
+        from dalle_pytorch_tpu.parallel.serve_specs import SERVE_AXIS
+        out = {"ok": self.engine_alive(),
+               # mesh observability (/healthz satellite): how many
+               # devices each replica's engine spans
+               "devices_per_replica": self.mesh_devices,
+               "mesh_shape": ({SERVE_AXIS: self.mesh_devices}
+                              if self.mesh_devices > 1 else None)}
         if self.replicas > 1:
             out["replicas"] = self.engine.replica_states()
         return out

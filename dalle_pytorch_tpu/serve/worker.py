@@ -66,9 +66,62 @@ _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
 # exit codes are protocol (the parent decodes them): 0 clean, 1 crash
 # (after a best-effort CRASH frame), 3 parent/transport gone, 4 the
 # parent rejected this worker's HELLO (bad token / index / version),
+# 5 the spec's local checkpoint is missing/invalid (ipc.BAD_CKPT_EXIT),
 # 137 RSS watchdog
 PARENT_GONE_EXIT = 3
 REJECTED_EXIT = 4
+
+
+class WorkerCheckpointError(RuntimeError):
+    """Typed local-checkpoint failure for a checkpoint-path attach spec
+    (``ReplicaSet(worker_ckpt=...)``): the path the spec named is
+    missing, fails ``checkpoint.validate`` (truncated payload, crc
+    mismatch, absent manifest), or — in ``latest:`` form — no valid
+    epoch exists at all. The worker ships the reason in a CRASH frame
+    and dies with ``ipc.BAD_CKPT_EXIT`` (5), so the parent's /healthz
+    shows an operator-actionable exit instead of a generic crash.
+    ``record`` is the structured event."""
+
+    def __init__(self, record: dict):
+        super().__init__(
+            f"worker checkpoint rejected: {record.get('reason')} "
+            f"(path {record.get('path')!r})")
+        self.record = record
+
+
+def load_ckpt_params(spec: dict):
+    """Resolve + validate + restore the params a checkpoint-path spec
+    names. Two forms: a concrete checkpoint directory (gated by
+    ``checkpoint.validate`` — never trust a checkpoint that a partial
+    rsync may have torn), or ``latest:<models_dir>:<name>`` resolved
+    through ``checkpoint.latest_valid`` (newest epoch that validates —
+    the same trust rule auto-resume uses)."""
+    from dalle_pytorch_tpu import checkpoint as ckpt
+    from dalle_pytorch_tpu.utils.metrics import structured_event
+
+    path = str(spec["ckpt_path"])
+    if path.startswith("latest:"):
+        try:
+            _, models_dir, name = path.split(":", 2)
+        except ValueError:
+            raise WorkerCheckpointError(structured_event(
+                "serve_worker_ckpt_invalid", path=path,
+                reason="malformed latest:<models_dir>:<name> spec")) \
+                from None
+        found = ckpt.latest_valid(models_dir, name)
+        if found is None:
+            raise WorkerCheckpointError(structured_event(
+                "serve_worker_ckpt_invalid", path=path,
+                reason=f"no valid checkpoint for {name!r} under "
+                       f"{models_dir!r}"))
+        path = found[0]
+    else:
+        ok, reason = ckpt.validate(path)
+        if not ok:
+            raise WorkerCheckpointError(structured_event(
+                "serve_worker_ckpt_invalid", path=path, reason=reason))
+    params, _manifest = ckpt.restore_params(path)
+    return params
 
 
 def rss_mb() -> int:
@@ -138,6 +191,15 @@ def _worker_shell(spec: dict, transport, start_seq: int) -> None:
         os._exit(PARENT_GONE_EXIT)  # parent/transport died: leak nothing
     except MemoryError:
         os._exit(ipc.OOM_EXIT)
+    except WorkerCheckpointError as e:
+        # typed, operator-actionable: ship the reason, die with the
+        # checkpoint exit code (the parent decodes 5 as 'fix the path /
+        # rsync the checkpoint', not as a crash to diff)
+        try:
+            sender.send(ipc.CRASH, {"error": repr(e)})
+        except Exception:   # noqa: BLE001 — the transport may be gone
+            pass
+        os._exit(ipc.BAD_CKPT_EXIT)
     except BaseException as e:  # noqa: BLE001 — ship the reason, then die
         try:
             sender.send(ipc.CRASH, {"error": repr(e)})
@@ -164,17 +226,35 @@ def _run(spec: dict, conn, sender: _FrameSender, rx_seq: int) -> None:
     from dalle_pytorch_tpu.serve.engine import Engine
 
     devices = jax.devices()
-    device = (devices[int(spec["device_index"]) % len(devices)]
-              if spec.get("place") else None)
     params = spec["params"]
-    if device is None:
-        # Engine device_puts params itself when placed; unplaced, do it
-        # here so the numpy pytree isn't re-uploaded every jit call
-        params = jax.device_put(params)
+    if params is None:
+        # checkpoint-path attach: the spec carried a path, not weights —
+        # load + validate LOCALLY (a remote host's own checkpoint store,
+        # never a multi-GB pickle over the wire)
+        params = load_ckpt_params(spec)
     queue = S.RequestQueue(max_depth=1 << 30, clock=time.perf_counter)
-    engine = Engine(params, spec["cfg"], queue, complete=None,
-                    clock=time.perf_counter, device=device,
-                    **spec["engine_kwargs"])
+    mesh_m = int(spec.get("devices_per_replica") or 1)
+    if mesh_m > 1:
+        # replica = mesh slice, in-child: same Engine surface, params +
+        # KV sharded over this worker's local device slice
+        from dalle_pytorch_tpu.parallel import serve_specs as SS
+        from dalle_pytorch_tpu.serve.mesh_engine import MeshEngine
+        device = SS.slice_devices(devices, int(spec["device_index"]),
+                                  mesh_m)
+        engine = MeshEngine(params, spec["cfg"], queue, complete=None,
+                            clock=time.perf_counter, devices=device,
+                            **spec["engine_kwargs"])
+    else:
+        device = (devices[int(spec["device_index"]) % len(devices)]
+                  if spec.get("place") else None)
+        if device is None:
+            # Engine device_puts params itself when placed; unplaced, do
+            # it here so the numpy pytree isn't re-uploaded every jit
+            # call
+            params = jax.device_put(params)
+        engine = Engine(params, spec["cfg"], queue, complete=None,
+                        clock=time.perf_counter, device=device,
+                        **spec["engine_kwargs"])
 
     open_handles: Dict[int, S.RequestHandle] = {}
     sender.send(ipc.READY, {"pid": os.getpid(), "device": str(device),
