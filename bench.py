@@ -1462,6 +1462,152 @@ def _serve_paged_attn_compare(params, cfg, *, num_slots, page_size,
     return out
 
 
+def _serve_sparse_reads_compare(*, num_slots=2, chunk_steps=8):
+    """Dense-reads vs sparsity-aware decode reads over the SAME burst —
+    the record ISSUE 12's acceptance names. Builds its own config: the
+    shared bench config has no sparse layers, and the tiny 24-token
+    sequence fits inside one VariableSparsity window (visibility would
+    degenerate to everything-visible), so this uses an ALL-sparse stack
+    (>= half sparse layers, trivially) with ``sparse_block=4`` (window
+    = 16 tokens) over a 72-token sequence — every decode position
+    sees <= 3 of its up-to-9 pages.
+
+    Two leg PAIRS over identical fully-provisioned paged engines and an
+    identical request stream — for each impl (the Pallas kernel and the
+    dense-view gather), dense reads vs sparsity-aware reads. ALWAYS
+    asserted: zero WITHIN-IMPL token mismatches (skipped pages carry
+    exactly-zero attention weight, so turning sparse reads on must not
+    move a single token), ONE decode compile per leg (the static
+    visibility tables must not retrace), and modeled sparse read-bytes
+    <= 0.5x dense for both impls (``ops.paged_attention.
+    modeled_kv_read_bytes_per_token``; HBM counters are not
+    host-observable so bytes are modeled, time is measured).
+    Kernel-vs-gather agreement is recorded unasserted
+    (``cross_impl_mismatches``) — bench runs bf16 params, where the
+    kernel's f32 accumulation is deliberately not bit-matched to the
+    gather's bf16 scores (the paged_attn_compare contract; the f32
+    byte-identity is pinned in tests/test_sparse_reads.py). The
+    ms/token win is asserted on REAL TPU only — on CPU the kernel runs
+    under the Pallas interpreter, whose emulation overhead is not the
+    hardware's (``asserted``: false)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.models import dalle as D
+    from dalle_pytorch_tpu.models import vae as V
+    from dalle_pytorch_tpu.ops import paged_attention as PA
+    from dalle_pytorch_tpu.serve import Request, RequestQueue, \
+        SamplingParams
+    from dalle_pytorch_tpu.serve.engine import Engine
+
+    vcfg = V.VAEConfig(image_size=32, num_tokens=32, codebook_dim=32,
+                       num_layers=2, hidden_dim=8)
+    cfg = D.DALLEConfig(dim=32, depth=2, vae=vcfg, num_text_tokens=64,
+                        text_seq_len=8, heads=2, dim_head=16,
+                        sparse_attn=True, sparse_block=4)
+    params = jax.device_put(D.dalle_init(jax.random.PRNGKey(0), cfg,
+                                         dtype=jnp.bfloat16))
+    page_size = 8
+    prompt_len = min(4, cfg.text_seq_len)
+    n_req = 2 * num_slots
+    tokens_per_req = cfg.seq_len - prompt_len
+    on_tpu = jax.default_backend() == "tpu"
+    out = {"page_size": page_size, "chunk_steps": chunk_steps,
+           "requests": n_req, "seq_len": cfg.seq_len,
+           "sparse_pattern": list(cfg.transformer.sparse_pattern),
+           "asserted": on_tpu}
+    legs = (("dense_reads", "kernel", False),
+            ("sparse_reads", "kernel", True),
+            ("dense_reads_gather", "gather", False),
+            ("sparse_reads_gather", "gather", True))
+    toks = {}
+    for name, impl, sparse in legs:
+        queue = RequestQueue(max_depth=2 * n_req + 4)
+        engine = Engine(params, cfg, queue, num_slots=num_slots,
+                        chunk_steps=chunk_steps, kv="paged",
+                        page_size=page_size, paged_attn=impl,
+                        sparse_reads=sparse)
+        # warm the decode program + prefill bucket outside the timing
+        h = queue.submit(Request(codes=(1,) * prompt_len, seed=0,
+                                 sampling=SamplingParams()))
+        engine.run_until_idle()
+        h.result(timeout=120)
+        t0 = time.perf_counter()
+        handles = [queue.submit(Request(
+            codes=(1 + i % 7,) * prompt_len, seed=i,
+            sampling=SamplingParams())) for i in range(n_req)]
+        engine.run_until_idle()
+        wall = time.perf_counter() - t0
+        results = [h.result(timeout=120) for h in handles]
+        ok = sum(r.status == "ok" for r in results)
+        if ok != n_req:
+            raise AssertionError(
+                f"sparse_reads leg {name}: only {ok}/{n_req} completed")
+        snap = engine.stats()
+        if snap["decode_compiles"] != 1:
+            raise AssertionError(
+                f"sparse_reads leg {name}: decode compiled "
+                f"{snap['decode_compiles']} times — the static "
+                f"visibility tables must live inside the ONE fused "
+                f"decode program")
+        toks[name] = [np.asarray(r.tokens) for r in results]
+        out[name] = {
+            "paged_attn": impl,
+            "sparse_reads": sparse,
+            "wall_s": round(wall, 4),
+            "ms_per_token": round(
+                1e3 * wall / (n_req * tokens_per_req), 4),
+            "kv_read_bytes_per_token": int(
+                PA.modeled_kv_read_bytes_per_token(
+                    depth=cfg.transformer.depth,
+                    heads=cfg.transformer.heads,
+                    dim_head=cfg.transformer.dim_head,
+                    total_len=cfg.seq_len, page_size=page_size,
+                    prompt_len=prompt_len, itemsize=2, impl=impl,
+                    sparse_reads=sparse,
+                    sparse_pattern=(cfg.transformer.sparse_pattern
+                                    if sparse else None),
+                    sparse_block=cfg.transformer.sparse_block)),
+            "decode_compiles": snap["decode_compiles"],
+        }
+    out["token_mismatches"] = int(sum(
+        not np.array_equal(a, b)
+        for dense_leg, sparse_leg in (("dense_reads", "sparse_reads"),
+                                      ("dense_reads_gather",
+                                       "sparse_reads_gather"))
+        for a, b in zip(toks[dense_leg], toks[sparse_leg])))
+    if out["token_mismatches"]:
+        raise AssertionError(
+            f"sparsity-aware reads moved tokens: "
+            f"{out['token_mismatches']} mismatched streams — skipped "
+            f"pages must carry exactly-zero attention weight")
+    out["cross_impl_mismatches"] = int(sum(
+        not np.array_equal(a, b)
+        for a, b in zip(toks["dense_reads"], toks["dense_reads_gather"])))
+    for dense_leg, sparse_leg in (("dense_reads", "sparse_reads"),
+                                  ("dense_reads_gather",
+                                   "sparse_reads_gather")):
+        dense_b = out[dense_leg]["kv_read_bytes_per_token"]
+        sparse_b = out[sparse_leg]["kv_read_bytes_per_token"]
+        if sparse_b > 0.5 * dense_b:
+            raise AssertionError(
+                f"sparsity-aware reads did not halve the modeled KV "
+                f"read traffic ({sparse_leg}): {sparse_b} vs {dense_b} "
+                f"bytes/token on an all-sparse config")
+    out["read_bytes_ratio"] = round(
+        out["dense_reads"]["kv_read_bytes_per_token"]
+        / max(out["sparse_reads"]["kv_read_bytes_per_token"], 1), 2)
+    if on_tpu and out["sparse_reads"]["ms_per_token"] \
+            >= out["dense_reads"]["ms_per_token"]:
+        raise AssertionError(
+            f"sparsity-aware reads did not beat dense reads on "
+            f"hardware: {out['sparse_reads']['ms_per_token']} vs "
+            f"{out['dense_reads']['ms_per_token']} ms/token")
+    return out
+
+
 def _serve_replica_compare(params, cfg, *, replicas, num_slots, n_req,
                            kv, page_size, chunk_steps=8):
     """The replica-set headline: N supervised engines behind one queue
@@ -2139,6 +2285,15 @@ def bench_serve(args):
         pa_compare = {"error": f"{type(e).__name__}: {e}"}
         errors.append(str(e))
 
+    _progress("serve: dense-reads vs sparsity-aware reads comparison")
+    try:
+        sparse_compare = _serve_sparse_reads_compare(
+            num_slots=min(num_slots, 2))
+    except Exception as e:  # noqa: BLE001 — same structured-error
+        # contract: the serve-perf sparse_reads CI leg greps for it
+        sparse_compare = {"error": f"{type(e).__name__}: {e}"}
+        errors.append(str(e))
+
     replica_compare = None
     if args.replicas > 1:
         _progress(f"serve: {args.replicas}-replica scaling + "
@@ -2211,6 +2366,7 @@ def bench_serve(args):
         "k_sweep": k_sweep, "transfer_clean": True,
         "kv_budget_compare": kv_compare,
         "paged_attn_compare": pa_compare,
+        "sparse_reads_compare": sparse_compare,
         "devices": len(jax.devices()), "backend": jax.default_backend(),
     }
     if mesh_compare is not None:

@@ -88,6 +88,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "'Paged attention kernel'). Requires --kv "
                         "paged and a page_size that is a multiple of "
                         "8 (the kernel's VMEM tile)")
+    p.add_argument("--sparse_reads", action="store_true",
+                   help="sparsity-aware decode reads (requires --kv "
+                        "paged and a model with sparse layers): sparse "
+                        "layers read only their statically visible KV "
+                        "pages — the trained block-local window plus "
+                        "the global text anchor — instead of the whole "
+                        "cached prefix. Tokens stay byte-identical "
+                        "(skipped pages carry exactly-zero attention "
+                        "weight); per-token KV read traffic drops by "
+                        "the visibility ratio (docs/SERVING.md 'Sparse "
+                        "decode reads')")
     p.add_argument("--num_pages", type=int, default=0,
                    help="physical pages in the pool incl. the reserved "
                         "trash page (paged mode; 0 = fully provisioned: "
@@ -124,7 +135,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "<name>' resolves the newest valid epoch), so "
                         "weights never cross the wire and a remote "
                         "host serves from its own checkpoint store. "
-                        "An invalid/missing checkpoint is a typed "
+                        "--use_ema/--quantize compose: each worker "
+                        "re-applies them after its local load, so "
+                        "every replica serves identical weights. "
+                        "An invalid/missing checkpoint (or EMA asked "
+                        "of an EMA-less checkpoint) is a typed "
                         "worker death (exit 5) on /healthz, not a "
                         "crash to diff")
     p.add_argument("--isolation", choices=("thread", "process"),
@@ -262,18 +277,20 @@ def main(argv=None):
             raise SystemExit(f"--prefill_buckets must be comma-separated "
                              f"ints, got {args.prefill_buckets!r}")
     if args.worker_ckpt and (args.use_ema or args.quantize != "none"):
-        # the worker loads the RAW checkpoint; silently serving
-        # different weights per worker would be a correctness bug
-        raise SystemExit("--worker_ckpt serves the checkpoint's stored "
-                         "weights as-is; it does not compose with "
-                         "--use_ema or --quantize yet")
+        # the attach spec carries the SAME transforms the parent just
+        # applied to its local copy: each worker re-applies them after
+        # its local load (serve/worker.py load_ckpt_params), so every
+        # replica serves identical weights — the PR-11 rejection of
+        # this combination is gone
+        say(f"worker_ckpt: workers apply use_ema={args.use_ema} "
+            f"quantize={args.quantize} after their local load")
     server = InferenceServer(
         params, vae_params, cfg, num_slots=args.num_slots,
         queue_depth=args.queue_depth, chunk_steps=args.chunk_steps,
         prefill_buckets=buckets,
         quantize_cache=args.quantize == "int8_kv",
         kv=args.kv, page_size=args.page_size, num_pages=args.num_pages,
-        paged_attn=args.paged_attn,
+        paged_attn=args.paged_attn, sparse_reads=args.sparse_reads,
         replicas=args.replicas, mesh_devices=args.mesh_devices,
         heartbeat_s=args.heartbeat_s,
         isolation=args.isolation,
@@ -281,12 +298,15 @@ def main(argv=None):
         transport=args.transport, worker_endpoint=args.worker_endpoint,
         worker_cmd=args.worker_cmd, attach_token=args.attach_token,
         worker_ckpt=args.worker_ckpt,
+        worker_use_ema=bool(args.worker_ckpt) and args.use_ema,
+        worker_quantize=args.quantize if args.worker_ckpt else "none",
         clip_params=clip_params, clip_cfg=clip_cfg, metrics=metrics,
         log_every=args.log_every, encode=vocab.encode,
         init_deadline_s=args.init_deadline_s,
         init_retries=args.init_retries).start()
     kv_desc = args.kv if args.kv == "dense" \
-        else f"{args.kv}/{args.paged_attn}"
+        else f"{args.kv}/{args.paged_attn}" \
+        + ("/sparse_reads" if args.sparse_reads else "")
     iso_desc = args.isolation if args.transport == "pipe" \
         else f"{args.isolation}/{args.transport}"
     mesh_desc = "" if args.mesh_devices <= 1 \

@@ -163,6 +163,89 @@ def _sparse_layout(cfg, total_len: int) -> Array:
     return jnp.asarray(np.asarray(layout)[:total_len, :total_len])
 
 
+def _sparse_page_visibility(cfg, total_len: int, page_size: int):
+    """Static per-position PAGE visibility for sparse layers — the page-
+    granular reduction of ``_sparse_layout``, resolved from config and
+    delegated to the CACHED shared source
+    (``ops.sparse.visible_pages_causal``; the engine's stats model and
+    bench read the same tables, so the precompute can never drift
+    between them).
+
+    Returns ``(vis (L, W) int32, cnt (L,), cnt_causal (L,))``: row p's
+    visible page ids ascending with ``cnt[p]`` live entries (the
+    any-token-in-page oracle), and ``cnt_causal[p]`` the decode trip
+    count."""
+    return sparse.visible_pages_causal(total_len, page_size,
+                                       cfg.sparse_block,
+                                       causal=cfg.causal)
+
+
+def _kernel_read(q: Array, k: Array, v: Array, pool_k: Array,
+                 pool_v: Array, block_tables: Array, pos: Array,
+                 allowed: Array, *, scale: float,
+                 ksc: Optional[Array] = None,
+                 vsc: Optional[Array] = None,
+                 visible: Optional[Array] = None,
+                 visible_cnt: Optional[Array] = None) -> Array:
+    """The kernel half of the cached-attention read seam: Pallas ragged
+    paged-attention partials over the raw page pool (``pool_k/pool_v``
+    consumed through the block tables in place), completed with the
+    current token's self-logit by the two-estimate softmax merge —
+    exactly ``softmax(concat([scores, self]))`` up to summation order,
+    the gather oracle's computation. ``visible``/``visible_cnt`` switch
+    the kernel to a sparse layer's statically visible page list
+    (sparsity-aware decode reads). Returns the (b, h, 1, dh) attention
+    output BEFORE out_sync/out-projection — the caller owns those."""
+    from dalle_pytorch_tpu.ops import paged_attention as PA
+    acc, m, l = PA.paged_decode_attention(
+        q[:, :, 0, :], pool_k, pool_v, block_tables, pos, allowed,
+        scale=scale, k_scales=ksc, v_scales=vsc, visible=visible,
+        visible_cnt=visible_cnt)
+    self_s = (jnp.einsum("bhqd,bhqd->bhq", q, k)[:, :, 0]
+              .astype(jnp.float32) * scale)                  # (b, h)
+    m_t = jnp.maximum(m, self_s)           # self is finite: m_t too
+    alpha = jnp.exp(m - m_t)
+    w_self = jnp.exp(self_s - m_t)
+    denom = l * alpha + w_self             # >= w_self > 0: no 0-div
+    out = (acc * alpha[..., None]
+           + w_self[..., None] * v[:, :, 0, :]
+           .astype(jnp.float32)) / denom[..., None]
+    return out.astype(q.dtype)[:, :, None, :]
+
+
+def _gather_read(q: Array, k: Array, v: Array, ck: Array, cv: Array,
+                 allowed: Array, *, scale: float,
+                 ksc: Optional[Array] = None,
+                 vsc: Optional[Array] = None) -> Array:
+    """The dense-view half of the cached-attention read seam: one
+    einsum softmax over a (b, heads, L, dh) view of the cached rows
+    (the real dense slot cache, ``paged_view``'s block-table gather,
+    or a visibility-trimmed slice of it) plus the self-logit. The
+    int8 cache reads int8 rows and upcasts in registers, scales
+    applied OUTSIDE the contractions (along j) so no dequantized copy
+    materializes — same trick as ops/quant. Returns the (b, h, 1, dh)
+    output BEFORE out_sync/out-projection."""
+    quantized = ksc is not None
+    ckc = ck.astype(q.dtype) if quantized else ck
+    scores = jnp.einsum("bhqd,bhjd->bhqj", q, ckc) * scale
+    if quantized:
+        # scales applied in the SCORE dtype: an f32 multiply would
+        # promote the whole decode carry to f32 under bf16 params
+        # (scan carry dtype mismatch) and double the vector bytes
+        scores = scores * ksc[:, :, None, :].astype(scores.dtype)
+    scores = jnp.where(allowed[:, None, None, :], scores,
+                       core.neg_inf(scores.dtype))
+    self_score = jnp.einsum("bhqd,bhqd->bhq", q, k)[..., None] * scale
+    w = jax.nn.softmax(jnp.concatenate([scores, self_score], -1), axis=-1)
+    wj = w[..., :-1]
+    if quantized:
+        wj = wj * vsc[:, :, None, :].astype(wj.dtype)
+        cvc = cv.astype(q.dtype)
+    else:
+        cvc = cv
+    return jnp.einsum("bhqj,bhjd->bhqd", wj, cvc) + w[..., -1:] * v
+
+
 def _attn_with_kv(lp: dict, h: Array, allowed: Array, cfg,
                   out_sync=None) -> Tuple[Array, Array, Array]:
     """PreNorm attention over an explicit allowed-mask; returns out, k, v.
@@ -308,6 +391,7 @@ def decode_step(params: dict, x_tok: Array, pos: Array, cache: dict, *, cfg,
 def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
                       *, cfg, key_mask: Array, attn_impl: str = "gather",
                       block_tables: Optional[Array] = None,
+                      sparse_reads: bool = False,
                       out_sync=None) -> Tuple[Array, Array, Array]:
     """The read half of ``decode_step``: attention over the cached rows
     plus self, WITHOUT the cache write-back. Returns (h_out (b, dim),
@@ -329,7 +413,21 @@ def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
     ORACLE: kernel output must be allclose to it under the same masks
     (rows >= pos dead, trash-page rows never attended), and emitted
     tokens byte-identical under greedy/seeded sampling
-    (tests/test_paged_attention.py)."""
+    (tests/test_paged_attention.py).
+
+    ``sparse_reads=True`` is the per-layer VISIBILITY seam (sparsity-
+    aware decode reads): ``cache`` must be the raw page pool for BOTH
+    impls, and sparse layers read only their statically visible pages
+    (``_decode_step_math_sparse_reads``) while dense layers read
+    exactly as here."""
+    if sparse_reads:
+        if block_tables is None:
+            raise ValueError("sparse_reads requires block_tables — page "
+                             "visibility lives in the paged KV layout")
+        return _decode_step_math_sparse_reads(
+            params, x_tok, pos, cache, cfg=cfg, key_mask=key_mask,
+            attn_impl=attn_impl, block_tables=block_tables,
+            out_sync=out_sync)
     from dalle_pytorch_tpu.ops import transformer as T
     b = x_tok.shape[0]
     total_len = key_mask.shape[1]
@@ -346,7 +444,6 @@ def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
                              "positions (the serving decode shape)")
         if block_tables is None:
             raise ValueError("attn_impl='kernel' requires block_tables")
-        from dalle_pytorch_tpu.ops import paged_attention as PA
 
     j = jnp.arange(total_len)
     # strictly-before rows; self added as the concatenated extra logit
@@ -375,56 +472,20 @@ def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
             if any_sparse else dense_allowed
         if kernel_mode:
             # ck/cv are the raw page pool for this layer; the kernel
-            # walks the block tables in place and returns unnormalized
-            # (acc, m, l) over the cached rows. Folding in the self
-            # logit with the two-estimate softmax merge reproduces
-            # softmax(concat([scores, self])) exactly up to summation
-            # order — the gather oracle's computation.
-            acc, m, l = PA.paged_decode_attention(
-                q[:, :, 0, :], ck, cv, block_tables, pos, allowed,
-                scale=cfg.scale, k_scales=ksc, v_scales=vsc)
-            self_s = (jnp.einsum("bhqd,bhqd->bhq", q, k)[:, :, 0]
-                      .astype(jnp.float32) * cfg.scale)        # (b, h)
-            m_t = jnp.maximum(m, self_s)       # self is finite: m_t too
-            alpha = jnp.exp(m - m_t)
-            w_self = jnp.exp(self_s - m_t)
-            denom = l * alpha + w_self         # >= w_self > 0: no 0-div
-            out = (acc * alpha[..., None]
-                   + w_self[..., None] * v[:, :, 0, :]
-                   .astype(jnp.float32)) / denom[..., None]
-            out = out.astype(q.dtype)[:, :, None, :]
-            if out_sync is not None:
-                # mesh-sharded serving (parallel/serve_specs.py): the
-                # per-head output is re-replicated HERE, so the out
-                # projection sees gathered heads (data movement) and
-                # never partial-sums its contraction across shards —
-                # the byte-identity contract's load-bearing constraint
-                out = out_sync(out)
-            return attn_ops.output_tail(p, out), k, v
-        # int8 cache: XLA reads int8 rows from HBM, upcasts in registers,
-        # and the per-row scales apply OUTSIDE the contractions (along j),
-        # so no dequantized copy materializes — same trick as ops/quant
-        ckc = ck.astype(q.dtype) if quantized else ck
-        scores = jnp.einsum("bhqd,bhjd->bhqj", q, ckc) * cfg.scale
-        if quantized:
-            # scales applied in the SCORE dtype: an f32 multiply would
-            # promote the whole decode carry to f32 under bf16 params
-            # (scan carry dtype mismatch) and double the vector bytes
-            scores = scores * ksc[:, :, None, :].astype(scores.dtype)
-        scores = jnp.where(allowed[:, None, None, :], scores,
-                           core.neg_inf(scores.dtype))
-        self_score = jnp.einsum("bhqd,bhqd->bhq", q, k)[..., None] * cfg.scale
-        w = jax.nn.softmax(jnp.concatenate([scores, self_score], -1), axis=-1)
-        wj = w[..., :-1]
-        if quantized:
-            wj = wj * vsc[:, :, None, :].astype(wj.dtype)
-            cvc = cv.astype(q.dtype)
+            # walks the block tables in place (_kernel_read completes
+            # the softmax with the self-logit merge)
+            out = _kernel_read(q, k, v, ck, cv, block_tables, pos,
+                               allowed, scale=cfg.scale, ksc=ksc,
+                               vsc=vsc)
         else:
-            cvc = cv
-        out = jnp.einsum("bhqj,bhjd->bhqd", wj, cvc) + w[..., -1:] * v
+            out = _gather_read(q, k, v, ck, cv, allowed,
+                               scale=cfg.scale, ksc=ksc, vsc=vsc)
         if out_sync is not None:
-            # see the kernel branch above: gather heads before the out
-            # projection instead of letting GSPMD partial-sum it
+            # mesh-sharded serving (parallel/serve_specs.py): the
+            # per-head output is re-replicated HERE, so the out
+            # projection sees gathered heads (data movement) and
+            # never partial-sums its contraction across shards —
+            # the byte-identity contract's load-bearing constraint
             out = out_sync(out)
         return attn_ops.output_tail(p, out), k, v
 
@@ -453,6 +514,181 @@ def _decode_step_math(params: dict, x_tok: Array, pos: Array, cache: dict,
     carry, (ks, vs) = lax.scan(body, carry0, xs)
     h_out = (carry[0] + carry[1]) * 0.5 if cfg.reversible else carry
 
+    return h_out[:, 0, :], ks, vs
+
+
+def _decode_step_math_sparse_reads(params: dict, x_tok: Array, pos: Array,
+                                   pool: dict, *, cfg, key_mask: Array,
+                                   attn_impl: str, block_tables: Array,
+                                   out_sync=None
+                                   ) -> Tuple[Array, Array, Array]:
+    """Sparsity-aware read twin of ``_decode_step_math`` (its
+    ``sparse_reads=True`` branch): the model's sparse layers were
+    trained to see only a block-local window plus the global blocks
+    (``_sparse_layout``), so at decode time most cached pages carry
+    exactly-zero attention weight for them — pure wasted read traffic.
+    Here each sparse layer reads ONLY its statically visible pages
+    (``_sparse_page_visibility``), dense layers read exactly what
+    ``_decode_step_math`` reads, and both impls consume the RAW page
+    pool (``pool``) through the block tables:
+
+      * ``'kernel'``: the Pallas ragged walk follows the per-slot
+        visible-page LIST instead of the prefix ``0..pages_for(pos)``
+        (token-causally trimmed counts). Skipped pages are fully
+        masked, so under the finite ``neg_inf`` fill the online
+        recurrence is BIT-EQUAL to the prefix walk.
+      * ``'gather'``: sparse layers gather only the visible slice of
+        the block table (``kv_pool.visible_table_view``, width = the
+        static max visible count) with the row mask remapped onto the
+        trimmed columns; dense layers gather the full view per layer.
+
+    The dense/sparse choice is resolved STATICALLY by unrolling one
+    period of ``cfg.sparse_pattern`` inside the layer scan (the
+    ops.transformer periodic idiom) — the trimmed sparse read has a
+    different SHAPE than the dense read, which a traced flag could
+    never select between. Aperiodic patterns are rejected upstream
+    (serve/engine.py) and here."""
+    from dalle_pytorch_tpu.ops import transformer as T
+    from dalle_pytorch_tpu.serve import kv_pool as KV
+    b = x_tok.shape[0]
+    total_len = key_mask.shape[1]
+    pattern = cfg.sparse_pattern
+    if not any(pattern):
+        raise ValueError("sparse_reads on a stack with no sparse layers "
+                         "would be a silent no-op — drop the flag")
+    period = T._pattern_period(pattern)
+    if period > T._MAX_UNROLL_PERIOD:
+        raise ValueError(
+            f"sparse_reads needs a periodic sparse pattern (period <= "
+            f"{T._MAX_UNROLL_PERIOD}) so the per-layer read shapes "
+            f"resolve statically; pattern {pattern} has period {period}")
+    if getattr(pos, "ndim", 0) != 1:
+        raise ValueError("sparse_reads requires per-slot (b,) positions "
+                         "(the serving decode shape)")
+    if attn_impl not in ("gather", "kernel"):
+        raise ValueError(f"attn_impl must be 'gather' or 'kernel', "
+                         f"got {attn_impl!r}")
+    kernel_mode = attn_impl == "kernel"
+    ps = pool["k"].shape[3]
+    quantized = "k_scale" in pool
+
+    j = jnp.arange(total_len)
+    causal_ok = j[None, :] < pos[:, None]
+    dense_allowed = causal_ok & key_mask                     # (b, L)
+    layout = _sparse_layout(cfg, total_len)
+    sparse_allowed = dense_allowed & jnp.take(layout, pos, axis=0)
+
+    vis_np, cnt_np, ccnt_np = _sparse_page_visibility(cfg, total_len, ps)
+    width = vis_np.shape[1]
+    # jaxlint: disable=JL001 — static-config visibility tables, trace-
+    # time constants hoisted once per compile (the _sparse_layout idiom)
+    vis_rows = jnp.take(jnp.asarray(vis_np), pos, axis=0)    # (b, W)
+    vis_cnt = jnp.take(jnp.asarray(cnt_np), pos)             # (b,)
+    vis_ccnt = jnp.take(jnp.asarray(ccnt_np), pos)           # (b,)
+
+    need = -(-total_len // ps)               # pages_for(total_len)
+    bt = block_tables[:, :need]              # paged_view's table trim
+    vis_bt = KV.visible_table_view(bt, vis_rows)             # (b, W)
+    # remap the row mask onto the trimmed columns: column w*ps + o of
+    # the visible view is logical row vis_rows[:, w]*ps + o; columns
+    # past the live count are dead (they would re-count page 0), and so
+    # are tail rows past total_len on a partial last page
+    cols = (vis_rows[:, :, None] * ps
+            + jnp.arange(ps)[None, None, :]).reshape(b, width * ps)
+    pad_ok = jnp.repeat(
+        jnp.arange(width)[None, :] < vis_cnt[:, None], ps, axis=1)
+    vis_allowed = (jnp.take_along_axis(
+        sparse_allowed, jnp.minimum(cols, total_len - 1), axis=1)
+        & pad_ok & (cols < total_len))
+
+    def layer_pool_view(ck, cv, ksc, vsc, tables, rows_out):
+        """``paged_view`` for ONE layer: ck/cv (P, heads, ps, dh)
+        gathered through tables (b, w) into (b, heads, rows_out[, dh])
+        — the per-layer form the statically-unrolled body needs, since
+        dense and sparse layers gather different widths."""
+        def rows(buf):
+            g = jnp.take(buf, tables, axis=0)    # (b, w, heads, ps, dh)
+            g = jnp.moveaxis(g, 1, 2)            # (b, heads, w, ps, dh)
+            g = g.reshape(g.shape[0], g.shape[1], -1, g.shape[-1])
+            return g[:, :, :rows_out, :]
+        def scales(buf):
+            g = jnp.take(buf, tables, axis=0)    # (b, w, heads, ps)
+            g = jnp.moveaxis(g, 1, 2)            # (b, heads, w, ps)
+            return g.reshape(g.shape[0], g.shape[1], -1)[:, :, :rows_out]
+        if ksc is None:
+            return rows(ck), rows(cv), None, None
+        return rows(ck), rows(cv), scales(ksc), scales(vsc)
+
+    def attn_layer(lp, h, ck, cv, ksc, vsc, is_sparse: bool):
+        p = lp["attn"]
+        hn = core.layernorm(p["ln"], h)
+        q, k, v = attn_ops.qkv_project(p, hn, cfg.heads)  # (b, h, 1, dh)
+        if kernel_mode:
+            out = _kernel_read(
+                q, k, v, ck, cv, block_tables, pos,
+                sparse_allowed if is_sparse else dense_allowed,
+                scale=cfg.scale, ksc=ksc, vsc=vsc,
+                visible=vis_rows if is_sparse else None,
+                visible_cnt=vis_ccnt if is_sparse else None)
+        elif is_sparse:
+            gk, gv, gks, gvs = layer_pool_view(ck, cv, ksc, vsc,
+                                               vis_bt, width * ps)
+            out = _gather_read(q, k, v, gk, gv, vis_allowed,
+                               scale=cfg.scale, ksc=gks, vsc=gvs)
+        else:
+            gk, gv, gks, gvs = layer_pool_view(ck, cv, ksc, vsc,
+                                               bt, total_len)
+            out = _gather_read(q, k, v, gk, gv, dense_allowed,
+                               scale=cfg.scale, ksc=gks, vsc=gvs)
+        if out_sync is not None:
+            # the mesh seam, unchanged: gather heads before the out
+            # projection instead of letting GSPMD partial-sum it
+            out = out_sync(out)
+        return attn_ops.output_tail(p, out), k, v
+
+    h_in = x_tok[:, None, :]                                  # (b, 1, dim)
+    nsteps = cfg.depth // period
+    period_pat = tuple(bool(s) for s in pattern[:period])
+
+    def fold(a):
+        return a.reshape(nsteps, period, *a.shape[1:])
+
+    bufs = (pool["k"], pool["v"]) + \
+        ((pool["k_scale"], pool["v_scale"]) if quantized else ())
+    xs = (jax.tree.map(fold, params),) + tuple(fold(a) for a in bufs)
+
+    def body(carry, xs):
+        if quantized:
+            lp, ck, cv, ksc, vsc = xs
+        else:
+            lp, ck, cv = xs
+        ks_p, vs_p = [], []
+        for i, is_sparse in enumerate(period_pat):
+            lpi = jax.tree.map(lambda a, _i=i: a[_i], lp)
+            ksci = ksc[i] if quantized else None
+            vsci = vsc[i] if quantized else None
+            if cfg.reversible:
+                x1, x2 = carry
+                a, k, v = attn_layer(lpi, x2, ck[i], cv[i], ksci, vsci,
+                                     is_sparse)
+                y1 = x1 + a
+                y2 = x2 + T.ff_or_moe(lpi, y1, cfg, None, False)[0]
+                carry = (y1, y2)
+            else:
+                h = carry
+                a, k, v = attn_layer(lpi, h, ck[i], cv[i], ksci, vsci,
+                                     is_sparse)
+                h = h + a
+                carry = h + T.ff_or_moe(lpi, h, cfg, None, False)[0]
+            ks_p.append(k)
+            vs_p.append(v)
+        return carry, (jnp.stack(ks_p), jnp.stack(vs_p))
+
+    carry0 = (h_in, h_in) if cfg.reversible else h_in
+    carry, (ks, vs) = lax.scan(body, carry0, xs)
+    h_out = (carry[0] + carry[1]) * 0.5 if cfg.reversible else carry
+    ks = ks.reshape(cfg.depth, *ks.shape[2:])
+    vs = vs.reshape(cfg.depth, *vs.shape[2:])
     return h_out[:, 0, :], ks, vs
 
 
@@ -564,6 +800,7 @@ def decode_step_paged(params: dict, x_tok: Array, pos: Array, pool: dict,
                       block_tables: Array, *, cfg, key_mask: Array,
                       total_len: int, active: Array,
                       attn_impl: str = "gather",
+                      sparse_reads: bool = False,
                       out_sync=None) -> Tuple[Array, dict]:
     """``decode_step`` against the paged pool. ``attn_impl='gather'``
     (default, the parity oracle) gathers the dense view through the
@@ -574,12 +811,17 @@ def decode_step_paged(params: dict, x_tok: Array, pos: Array, pool: dict,
     the same ``_decode_step_math`` body merges its partials, so the
     two impls share every line outside the K/V read itself. Either
     way the new row scatters back into its page; ``active`` routes
-    dead slots' writes to the trash page (``_store_rows_paged``)."""
-    if attn_impl == "kernel":
+    dead slots' writes to the trash page (``_store_rows_paged``).
+
+    ``sparse_reads=True`` hands BOTH impls the raw pool: sparse layers
+    read only their statically visible pages while dense layers read
+    as before (``_decode_step_math_sparse_reads``) — same step math,
+    same writers, fewer bytes moved per token."""
+    if attn_impl == "kernel" or sparse_reads:
         h_out, ks, vs = _decode_step_math(
             params, x_tok, pos, pool, cfg=cfg, key_mask=key_mask,
-            attn_impl="kernel", block_tables=block_tables,
-            out_sync=out_sync)
+            attn_impl=attn_impl, block_tables=block_tables,
+            sparse_reads=sparse_reads, out_sync=out_sync)
     else:
         view = paged_view(pool, block_tables, total_len)
         h_out, ks, vs = _decode_step_math(params, x_tok, pos, view,
@@ -592,6 +834,7 @@ def decode_loop_paged(params: dict, cur_tok: Array, pos: Array,
                       active: Array, pool: dict, block_tables: Array, *,
                       cfg, key_mask: Array, total_len: int, steps: int,
                       embed_fn, sample_fn, attn_impl: str = "gather",
+                      sparse_reads: bool = False,
                       out_sync=None
                       ) -> Tuple[Array, Array, Array, dict, Array]:
     """``decode_loop`` over the paged pool: the same one-compile fused
@@ -603,7 +846,10 @@ def decode_loop_paged(params: dict, cur_tok: Array, pos: Array,
     trash page; emit semantics (-1 sentinel) are identical to the dense
     loop. ``attn_impl`` selects the per-step K/V read: the dense-view
     gather (oracle) or the in-place Pallas kernel — both run inside the
-    SAME fused scan, so the one-compile/emit-ring regime is unchanged."""
+    SAME fused scan, so the one-compile/emit-ring regime is unchanged.
+    ``sparse_reads`` turns on sparsity-aware reads for the sparse
+    layers (visibility tables are trace-time constants, so the fused
+    program still traces exactly once)."""
 
     def one_step(carry, _):
         cur_tok, pos, act, pool = carry
@@ -613,6 +859,7 @@ def decode_loop_paged(params: dict, cur_tok: Array, pos: Array,
                                     cfg=cfg, key_mask=key_mask,
                                     total_len=total_len, active=act,
                                     attn_impl=attn_impl,
+                                    sparse_reads=sparse_reads,
                                     out_sync=out_sync)
         nxt = sample_fn(h, pos + 1)
         pos = pos + 1

@@ -73,20 +73,35 @@ from dalle_pytorch_tpu.ops import core
 Array = jax.Array
 
 # finite mask fill, BY CONSTRUCTION the gather path's substitution
-# constant (ops.core.neg_inf): masked rows underflow to exactly 0
-# weight once any live score enters the running max, so degenerate rows
-# agree exactly between the kernel and the oracle — if neg_inf ever
-# changed, both paths would move together instead of silently diverging
-FILL = float(core.neg_inf(jnp.float32))
+# constant (ops.core.neg_inf = -finfo(dtype).max): masked rows underflow
+# to exactly 0 weight once any live score enters the running max, so
+# degenerate rows agree exactly between the kernel and the oracle — the
+# same -finfo max formula, spelled in dtype METADATA rather than through
+# a jnp op, because this module may first be imported from inside a
+# traced function (the decode scan's lazy import) where any jnp op
+# would become an abstract tracer (tests pin the equality)
+FILL = -float(jnp.finfo(jnp.float32).max)
 
 NUM_LANES = 128        # f32 VREG lane width — m/l stats stored broadcast
 
 
-def _kernel(pos_ref, bt_ref, q_ref, allowed_ref, k_ref, v_ref, *refs,
+def _kernel(pos_ref, bt_ref, *refs,
             scale: float, page_size: int, head_tile: int,
-            quantized: bool):
+            quantized: bool, visible: bool):
     """One (slot, head-tile) program: walk the slot's mapped pages with
-    double-buffered HBM->VMEM DMA, accumulate the online softmax."""
+    double-buffered HBM->VMEM DMA, accumulate the online softmax.
+
+    ``visible=True`` is the sparsity-aware walk: instead of the prefix
+    ``0..ceil(pos/ps)``, the trip follows the slot's precomputed
+    visible-page LIST (``vis_ref``, ascending logical page ids,
+    ``cnt_ref`` live entries — ops.sparse.visible_pages with the
+    token-causal trim applied by the caller). Skipped pages carry
+    exactly-zero softmax weight under the finite FILL, so the online
+    recurrence over the remaining (still ascending) pages is bit-equal
+    to the prefix walk: max(m, FILL)=m, l*exp(0)+0=l, acc*1+0=acc."""
+    if visible:
+        vis_ref, cnt_ref, *refs = refs
+    q_ref, allowed_ref, k_ref, v_ref, *refs = refs
     if quantized:
         (ksc_ref, vsc_ref, acc_ref, m_ref, l_ref,
          kbuf, vbuf, kscb, vscb, sem_k, sem_v, sem_ks, sem_vs) = refs
@@ -97,14 +112,22 @@ def _kernel(pos_ref, bt_ref, q_ref, allowed_ref, k_ref, v_ref, *refs,
     posi = pos_ref[0, 0]
     # ragged trip count: rows [0, pos) span ceil(pos/ps) pages; a dead
     # slot parked at pos 0 walks ZERO pages (its block-table entry 0
-    # points at the trash page, which is therefore never fetched)
-    n_pages = lax.div(posi + (ps - 1), ps)
+    # points at the trash page, which is therefore never fetched).
+    # Under the visible walk the count is the precomputed per-slot
+    # visible-page count instead — same raggedness, fewer trips.
+    n_pages = cnt_ref[0, 0] if visible \
+        else lax.div(posi + (ps - 1), ps)
     heads0 = t * ht
+
+    def logical(p):
+        """Trip p's LOGICAL page id: p itself on the prefix walk, the
+        p-th visible page on the sparsity-aware walk."""
+        return vis_ref[0, p] if visible else p
 
     def copies(slot, p):
         """The (slot, page) DMA descriptor set — recreated identically
         for start and wait (the wait must describe the copy it joins)."""
-        page = bt_ref[0, p]
+        page = bt_ref[0, logical(p)]
         hs = pl.ds(heads0, ht)
         out = [pltpu.make_async_copy(k_ref.at[page, hs], kbuf.at[slot],
                                      sem_k.at[slot]),
@@ -138,7 +161,7 @@ def _kernel(pos_ref, bt_ref, q_ref, allowed_ref, k_ref, v_ref, *refs,
         for dma in copies(slot, p):
             dma.wait()
 
-        ok = allowed_ref[0, pl.ds(p * ps, ps)] != 0        # (ps,)
+        ok = allowed_ref[0, pl.ds(logical(p) * ps, ps)] != 0   # (ps,)
         # per-head 2-D MXU dots (static unroll over the tile): q_h
         # (1, dh) x page (ps, dh)^T -> (1, ps) scores in f32
         s_rows, pv_holder = [], []
@@ -193,6 +216,8 @@ def paged_decode_attention(q: Array, k_pages: Array, v_pages: Array,
                            allowed: Array, *, scale: float,
                            k_scales: Optional[Array] = None,
                            v_scales: Optional[Array] = None,
+                           visible: Optional[Array] = None,
+                           visible_cnt: Optional[Array] = None,
                            head_tile: int = 0,
                            interpret: Optional[bool] = None,
                            ) -> Tuple[Array, Array, Array]:
@@ -204,6 +229,17 @@ def paged_decode_attention(q: Array, k_pages: Array, v_pages: Array,
     block_tables: (b, max_pages) int32; pos: (b,) int32 per-slot
     positions; allowed: (b, L) bool — the gather path's full row mask
     (causal & pad & sparse), True = attend.
+
+    ``visible``/``visible_cnt`` (both or neither) select the
+    sparsity-aware walk: visible (b, W) int32 lists each slot's
+    visible LOGICAL page ids in ascending order (entries must index
+    ``block_tables`` columns), visible_cnt (b,) int32 how many are
+    live — the per-(slot, layer) trip list a sparse layer's statically
+    precomputed page visibility produces (ops.sparse.visible_pages;
+    the caller applies the token-causal trim so entries never start at
+    or past ``pos``). The kernel then fetches ONLY those pages; every
+    skipped page is fully masked in ``allowed`` so its softmax weight
+    is exactly zero and the partials are bit-equal to the prefix walk.
 
     Returns f32 ``(acc, m, l)``: acc (b, heads, dh) the unnormalized
     exp-weighted V sum over cached rows, m (b, heads) the running max
@@ -219,6 +255,10 @@ def paged_decode_attention(q: Array, k_pages: Array, v_pages: Array,
     L = allowed.shape[1]
     KV.validate_page_size(page_size)
     quantized = k_scales is not None
+    if (visible is None) != (visible_cnt is None):
+        raise ValueError("visible and visible_cnt come together: the "
+                         "visible-page list is meaningless without its "
+                         "per-slot live count (and vice versa)")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     ht = int(head_tile) or heads
@@ -229,6 +269,10 @@ def paged_decode_attention(q: Array, k_pages: Array, v_pages: Array,
         raise ValueError(
             f"block tables map {max_pages} pages of {page_size} rows "
             f"< allowed length {L}")
+    if visible is not None and visible.shape[1] > max_pages:
+        raise ValueError(
+            f"visible lists {visible.shape[1]} pages per slot > the "
+            f"{max_pages}-column block tables they index")
     # pad the mask out to whole pages: the last page can span logical
     # rows past L, and pl.ds CLAMPS out-of-bounds starts (dynamic_slice
     # semantics) — an unpadded mask would alias the tail page onto the
@@ -239,21 +283,33 @@ def paged_decode_attention(q: Array, k_pages: Array, v_pages: Array,
 
     kernel = functools.partial(
         _kernel, scale=float(scale), page_size=page_size, head_tile=ht,
-        quantized=quantized)
+        quantized=quantized, visible=visible is not None)
 
     in_specs = [
         pl.BlockSpec((1, 1), lambda i, t: (i, 0),
                      memory_space=pltpu.SMEM),              # pos
         pl.BlockSpec((1, max_pages), lambda i, t: (i, 0),
                      memory_space=pltpu.SMEM),              # block table
+    ]
+    inputs = [pos.astype(jnp.int32).reshape(b, 1),
+              block_tables.astype(jnp.int32)]
+    if visible is not None:
+        w_vis = visible.shape[1]
+        in_specs += [
+            pl.BlockSpec((1, w_vis), lambda i, t: (i, 0),
+                         memory_space=pltpu.SMEM),          # visible list
+            pl.BlockSpec((1, 1), lambda i, t: (i, 0),
+                         memory_space=pltpu.SMEM),          # visible count
+        ]
+        inputs += [visible.astype(jnp.int32),
+                   visible_cnt.astype(jnp.int32).reshape(b, 1)]
+    in_specs += [
         pl.BlockSpec((1, ht, dh), lambda i, t: (i, t, 0)),  # q tile
         pl.BlockSpec((1, L_pages), lambda i, t: (i, 0)),    # allowed row
         pl.BlockSpec(memory_space=pltpu.ANY),               # K pool (HBM)
         pl.BlockSpec(memory_space=pltpu.ANY),               # V pool (HBM)
     ]
-    inputs = [pos.astype(jnp.int32).reshape(b, 1),
-              block_tables.astype(jnp.int32),
-              q, allowed.astype(jnp.int32), k_pages, v_pages]
+    inputs += [q, allowed.astype(jnp.int32), k_pages, v_pages]
     scratch = [
         pltpu.VMEM((2, ht, page_size, dh), k_pages.dtype),  # K double buf
         pltpu.VMEM((2, ht, page_size, dh), v_pages.dtype),  # V double buf
@@ -293,7 +349,11 @@ def modeled_kv_read_bytes_per_token(*, depth: int, heads: int,
                                     dim_head: int, total_len: int,
                                     page_size: int, prompt_len: int,
                                     itemsize: int, impl: str,
-                                    quantized: bool = False) -> float:
+                                    quantized: bool = False,
+                                    sparse_reads: bool = False,
+                                    sparse_pattern=None,
+                                    sparse_block: int = 16,
+                                    causal: bool = True) -> float:
     """Analytic KV-read bytes per decoded token for one slot — the
     number ``bench_serve --serve_paged_attn`` records for both legs
     (HBM counters are not observable from the host, and on CPU the
@@ -302,17 +362,44 @@ def modeled_kv_read_bytes_per_token(*, depth: int, heads: int,
     position, the kernel reads only the ``ceil(pos/page_size)`` mapped
     pages, averaged over the decode span ``[prompt_len, total_len)``).
     K + V both counted; the int8 pool adds one f32 scale per row per
-    K and V."""
+    K and V.
+
+    ``sparse_reads=True`` models the sparsity-aware read
+    (``sparse_pattern`` required — the per-layer dense/sparse tuple):
+    dense layers read as above, sparse layers read only their
+    statically visible pages (``ops.sparse.visible_pages`` on the
+    VariableSparsity layout) — the kernel walks the token-causal
+    visible count per position, the gather reads the fixed trimmed
+    width ``W`` (the fixed-shape program's static bound)."""
     row = 2 * dim_head * itemsize          # K + V
     if quantized:
         row += 2 * 4                        # per-row f32 scales
+    span = range(int(prompt_len), int(total_len))
     if impl == "gather":
         rows = float(total_len)
     elif impl == "kernel":
-        span = range(int(prompt_len), int(total_len))
         rows = (sum(-(-p // page_size) for p in span)   # ceil(pos/ps)
                 * page_size / max(len(span), 1))
     else:
         raise ValueError(f"impl must be 'gather' or 'kernel', got "
                          f"{impl!r}")
-    return depth * heads * rows * row
+    if not sparse_reads:
+        return depth * heads * rows * row
+    if sparse_pattern is None or len(sparse_pattern) != depth:
+        raise ValueError("sparse_reads=True needs the per-layer "
+                         "sparse_pattern (length == depth) to split "
+                         "dense from sparse layer reads")
+    # the CACHED shared precompute the decode step math itself walks
+    # (ops.sparse.visible_pages_causal via decode._sparse_page_
+    # visibility) — one source, so the model cannot drift from the read
+    from dalle_pytorch_tpu.ops import sparse as sparse_ops
+    vis, _cnt, cnt_causal = sparse_ops.visible_pages_causal(
+        total_len, page_size, sparse_block, causal=causal)
+    if impl == "gather":
+        rows_sparse = float(vis.shape[1] * page_size)
+    else:
+        rows_sparse = (sum(int(cnt_causal[p]) for p in span)
+                       * page_size / max(len(span), 1))
+    n_sparse = sum(bool(s) for s in sparse_pattern)
+    return heads * row * ((depth - n_sparse) * rows
+                          + n_sparse * rows_sparse)

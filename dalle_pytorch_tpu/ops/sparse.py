@@ -71,6 +71,81 @@ def token_layout_mask(seq_len: int, block: int = 16, *,
     return np.repeat(np.repeat(layout, block, axis=0), block, axis=1)
 
 
+def visible_pages(seq_len: int, page_size: int, block: int = 16, *,
+                  num_local_blocks: int = 4,
+                  global_blocks: Tuple[int, ...] = (0,),
+                  causal: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-position visible KV-page sets under the VariableSparsity layout.
+
+    The layout is STATIC (config only), so "which pages can position p
+    see" is a precomputable fact: for pages of ``page_size`` rows, page g
+    is visible at position p iff ANY token in ``[g*page_size,
+    (g+1)*page_size)`` is allowed by row p of ``token_layout_mask`` —
+    the any-token-in-page reduction. Because the layout is a local
+    window plus the global blocks (the text anchor), the visible set is
+    tiny and near-constant in ``seq_len``, which is what makes
+    sparsity-aware decode reads worth it (ops.decode /
+    ops.paged_attention consume these tables; docs/SERVING.md "Sparse
+    decode reads").
+
+    Returns ``(vis, cnt)``: ``vis`` is ``(seq_len, W)`` int32 with row p
+    listing p's visible page ids in ASCENDING order (``W`` = the max
+    count over positions — the static width a fixed-shape decode
+    program needs), padded with 0 past ``cnt[p]``; ``cnt`` is
+    ``(seq_len,)`` int32. Padding entries are NOT visibility grants —
+    consumers must mask columns >= cnt[p] (page 0 genuinely visible is
+    always listed inside the counted prefix).
+    """
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    padded = ((seq_len + block - 1) // block) * block
+    layout = token_layout_mask(padded, block,
+                               num_local_blocks=num_local_blocks,
+                               global_blocks=global_blocks,
+                               causal=causal)[:seq_len, :seq_len]
+    num_pages = -(-seq_len // page_size)
+    pad_cols = num_pages * page_size - seq_len
+    if pad_cols:
+        layout = np.pad(layout, ((0, 0), (0, pad_cols)))
+    page_vis = layout.reshape(seq_len, num_pages, page_size).any(-1)
+    cnt = page_vis.sum(-1).astype(np.int32)
+    width = max(int(cnt.max()), 1)
+    # stable argsort of ~visible floats the visible page ids to the
+    # front of each row IN ascending-page order (stability keeps it)
+    order = np.argsort(~page_vis, axis=1, kind="stable")[:, :width]
+    vis = order.astype(np.int32)
+    vis[np.arange(width)[None, :] >= cnt[:, None]] = 0
+    return vis, cnt
+
+
+@functools.lru_cache(maxsize=32)
+def visible_pages_causal(seq_len: int, page_size: int, block: int = 16, *,
+                         num_local_blocks: int = 4,
+                         global_blocks: Tuple[int, ...] = (0,),
+                         causal: bool = True
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Cached ``visible_pages`` plus the DECODE trip count — the one
+    shared source for the sparse-reads step math (ops.decode), the
+    engine's /stats read-bytes model (serve.engine), and bench, so the
+    three can never drift on what "visible" means. ``cnt_causal[p]``
+    counts the visible pages starting strictly before p (a page at or
+    past p holds no readable rows yet); the visible list is ascending,
+    so the causal subset is a PREFIX of it. The returned arrays are
+    frozen (write=False): the cache shares them across callers, and an
+    in-place edit would silently corrupt every later consumer's
+    visibility."""
+    vis, cnt = visible_pages(seq_len, page_size, block,
+                             num_local_blocks=num_local_blocks,
+                             global_blocks=global_blocks, causal=causal)
+    width = vis.shape[1]
+    live = np.arange(width)[None, :] < cnt[:, None]
+    before = vis * page_size < np.arange(seq_len)[:, None]
+    cnt_causal = (live & before).sum(1).astype(np.int32)
+    for a in (vis, cnt, cnt_causal):
+        a.setflags(write=False)
+    return vis, cnt, cnt_causal
+
+
 def sparse_attention_ref(q: Array, k: Array, v: Array, *, scale: float,
                          causal: bool, block: int = 16,
                          mask: Optional[Array] = None,

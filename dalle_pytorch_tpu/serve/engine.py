@@ -140,6 +140,7 @@ class Engine:
                  page_size: int = 0,
                  num_pages: int = 0,
                  paged_attn: str = "gather",
+                 sparse_reads: bool = False,
                  clock: Callable[[], float] = time.perf_counter,
                  device=None):
         import jax
@@ -187,6 +188,34 @@ class Engine:
                              "(the kernel reads the page pool through "
                              "block tables; the dense slot cache has "
                              "neither)")
+        # sparsity-aware decode reads: sparse layers read only their
+        # statically visible pages (ops.sparse.visible_pages) instead of
+        # the whole cached prefix — tokens stay byte-identical (the
+        # skipped pages carry exactly-zero attention weight), only the
+        # per-token KV read traffic shrinks (docs/SERVING.md "Sparse
+        # decode reads"). All three preconditions are typed here, at
+        # construction, not as trace-time surprises.
+        self.sparse_reads = bool(sparse_reads)
+        if self.sparse_reads:
+            if self.kv != "paged":
+                raise ValueError("sparse_reads requires kv='paged' — "
+                                 "page visibility lives in the paged "
+                                 "KV layout (block tables)")
+            pattern = cfg.transformer.sparse_pattern
+            if not any(pattern):
+                raise ValueError(
+                    "sparse_reads on a config with no sparse layers "
+                    "would be a silent no-op (every layer reads the "
+                    "full prefix either way) — drop the flag")
+            from dalle_pytorch_tpu.ops import transformer as T_ops
+            period = T_ops._pattern_period(pattern)
+            if period > T_ops._MAX_UNROLL_PERIOD:
+                raise ValueError(
+                    f"sparse_reads needs a periodic dense/sparse "
+                    f"pattern (period <= {T_ops._MAX_UNROLL_PERIOD}) "
+                    f"so the per-layer read shapes resolve statically "
+                    f"in the fused decode program; pattern {pattern} "
+                    f"has period {period}")
 
         if prefill_buckets is None:
             buckets = S.prefill_buckets(cfg.text_seq_len)
@@ -287,6 +316,9 @@ class Engine:
             jnp.zeros((S_,), jnp.float32)))
         self.slots: List[Optional[_Slot]] = [None] * S_
         self._pending: deque = deque()   # dispatched, un-harvested chunks
+        # memo for the config-static /stats read-bytes model, keyed by
+        # the sparse_reads flag it was asked for
+        self._modeled_read_bytes: Dict[bool, int] = {}
 
         # counters (stats()/bench_serve read these)
         self.decode_traces = 0          # bumped only while TRACING: the
@@ -451,6 +483,7 @@ class Engine:
             key_mask=self.key_mask, total_len=self.total_len,
             steps=self.chunk_steps, embed_fn=embed_fn,
             sample_fn=sample_fn, attn_impl=self.paged_attn,
+            sparse_reads=self.sparse_reads,
             out_sync=self._decode_out_sync())
 
     def _prefill_fn(self, bucket: int):
@@ -1223,6 +1256,36 @@ class Engine:
         from dalle_pytorch_tpu.serve import kv_pool as KV
         return KV.pool_bytes(self.cache)
 
+    def modeled_kv_read_bytes_per_token(self, sparse_reads=None) -> int:
+        """Analytic per-token KV READ bytes for this engine's decode
+        configuration (paged mode only; 0 otherwise) — HBM counters are
+        not host-observable, so /stats carries the model
+        (``ops.paged_attention.modeled_kv_read_bytes_per_token``,
+        averaged over a decode span starting at the smallest prefill
+        bucket). ``sparse_reads=False`` asks for the dense-reads
+        baseline of the same config, which is how /stats can show the
+        dense-vs-sparse read ratio this engine is getting. Config-
+        static, so the value is computed once per mode and memoized —
+        /stats, /healthz, and worker STATS frames poll this."""
+        if self.kv != "paged":
+            return 0
+        sr = self.sparse_reads if sparse_reads is None else bool(sparse_reads)
+        if sr in self._modeled_read_bytes:
+            return self._modeled_read_bytes[sr]
+        from dalle_pytorch_tpu.ops import paged_attention as PA
+        tcfg = self.cfg.transformer
+        out = int(PA.modeled_kv_read_bytes_per_token(
+            depth=tcfg.depth, heads=tcfg.heads, dim_head=tcfg.dim_head,
+            total_len=self.total_len, page_size=self.page_size,
+            prompt_len=min(self.buckets),
+            itemsize=self.cache["k"].dtype.itemsize,
+            impl=self.paged_attn, quantized=self.quantize_cache,
+            sparse_reads=sr,
+            sparse_pattern=tcfg.sparse_pattern if sr else None,
+            sparse_block=tcfg.sparse_block, causal=tcfg.causal))
+        self._modeled_read_bytes[sr] = out
+        return out
+
     def pages_in_use_p95(self) -> int:
         """Nearest-rank p95 of pages in use, sampled at every chunk
         dispatch (paged mode only; 0 before any dispatch)."""
@@ -1247,6 +1310,15 @@ class Engine:
         if self.kv == "paged":
             paged = {
                 "paged_attn": self.paged_attn,
+                "sparse_reads": self.sparse_reads,
+                # modeled per-token KV read traffic, current mode vs the
+                # dense-reads baseline — the pair whose ratio is the
+                # sparse-reads win (equal when sparse_reads is off)
+                "kv_read_bytes_per_token":
+                    self.modeled_kv_read_bytes_per_token(),
+                "kv_read_bytes_per_token_dense_reads":
+                    self.modeled_kv_read_bytes_per_token(
+                        sparse_reads=False),
                 "page_size": self.page_size,
                 "num_pages": self.num_pages,
                 "pages_in_use": self.alloc.in_use,

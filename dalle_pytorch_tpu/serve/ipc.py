@@ -246,6 +246,8 @@ class ChildEngineClient:
                  place: bool = False,
                  devices_per_replica: int = 1,
                  ckpt_path: Optional[str] = None,
+                 ckpt_use_ema: bool = False,
+                 ckpt_quantize: str = "none",
                  heartbeat_interval_s: float = 0.05,
                  rss_limit_mb: int = 0,
                  fault_plan: Optional[dict] = None,
@@ -275,6 +277,13 @@ class ChildEngineClient:
             # weight pytree to a path string
             "params": None if ckpt_path is not None else params,
             "ckpt_path": ckpt_path,
+            # worker-side serving transforms for ckpt-path specs: the
+            # worker applies EMA swap / int8 quantization AFTER its
+            # local load (serve/worker.py load_ckpt_params), so remote
+            # workers serve the same weights --use_ema/--quantize give
+            # the in-process engine
+            "ckpt_use_ema": bool(ckpt_use_ema),
+            "ckpt_quantize": str(ckpt_quantize),
             "cfg": cfg,
             "engine_kwargs": dict(engine_kwargs),
             "device_index": int(device_index),

@@ -131,6 +131,21 @@ def init_page_pool(cfg, num_pages: int, page_size: int, dtype=None,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def visible_table_view(block_tables, visible):
+    """Visibility-trimmed view of per-slot block tables: row i of the
+    result lists the PHYSICAL pages behind slot i's visible logical
+    pages — ``visible`` (b, W) int32 is the per-position visible-page
+    list ``ops.sparse.visible_pages`` precomputes, indexed at each
+    slot's current position (sparsity-aware decode reads,
+    docs/SERVING.md "Sparse decode reads"). Entries past the visible
+    count mirror whatever the padding entries map (logical page 0);
+    consumers must mask those columns — the view narrows the READ, the
+    mask still decides attendance. Traced code (jax.numpy), called
+    from inside the fused decode program."""
+    import jax.numpy as jnp
+    return jnp.take_along_axis(block_tables, visible, axis=1)
+
+
 def pool_bytes(pool: dict) -> int:
     """Resident HBM bytes of a pool (or of a dense cache dict) — the
     number ``bench_serve --serve_kv`` compares across layouts."""

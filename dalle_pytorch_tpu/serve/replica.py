@@ -173,6 +173,7 @@ class ReplicaSet:
                  page_size: int = 0,
                  num_pages: int = 0,
                  paged_attn: str = "gather",
+                 sparse_reads: bool = False,
                  clock: Callable[[], float] = time.perf_counter,
                  heartbeat_s: float = 5.0,
                  bringup_policy=None,
@@ -187,6 +188,8 @@ class ReplicaSet:
                  worker_cmd: Optional[str] = None,
                  attach_token: Optional[str] = None,
                  worker_ckpt: Optional[str] = None,
+                 worker_use_ema: bool = False,
+                 worker_quantize: str = "none",
                  devices_per_replica: int = 1):
         import jax
 
@@ -214,6 +217,22 @@ class ReplicaSet:
                 "that a worker on ANOTHER host loads weights from its "
                 "local checkpoint store instead of receiving pickled "
                 "params over the wire")
+        self.worker_use_ema = bool(worker_use_ema)
+        self.worker_quantize = str(worker_quantize)
+        if self.worker_quantize not in ("none", "int8", "int8_kv"):
+            raise ValueError(f"worker_quantize must be 'none', 'int8' "
+                             f"or 'int8_kv', got {worker_quantize!r}")
+        if (self.worker_use_ema or self.worker_quantize != "none") \
+                and worker_ckpt is None:
+            # these describe the WORKER's local load path; without a
+            # ckpt-path spec the parent's (already transformed) params
+            # cross the boundary and the flags would silently do
+            # nothing — the same misconfiguration hazard as worker_cmd
+            raise ValueError(
+                "worker_use_ema/worker_quantize transform the "
+                "checkpoint a worker loads locally — they need "
+                "worker_ckpt (without it, pass params you transformed "
+                "yourself)")
         self.devices_per_replica = int(devices_per_replica)
         if self.devices_per_replica < 1:
             raise ValueError(f"devices_per_replica must be >= 1, got "
@@ -259,7 +278,7 @@ class ReplicaSet:
             prefill_buckets=prefill_buckets, metrics=metrics,
             log_every=log_every, quantize_cache=quantize_cache,
             kv=kv, page_size=page_size, num_pages=num_pages,
-            paged_attn=paged_attn)
+            paged_attn=paged_attn, sparse_reads=sparse_reads)
         self.worker_ckpt = worker_ckpt
         if self.isolation == "process":
             import numpy as np
@@ -279,7 +298,7 @@ class ReplicaSet:
                 prefill_buckets=prefill_buckets,
                 quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
-                paged_attn=paged_attn)
+                paged_attn=paged_attn, sparse_reads=sparse_reads)
             # routing needs page math without an Engine in-process:
             # mirror the engine's bucket/page-size resolution
             self._buckets = (S.prefill_buckets(cfg.text_seq_len)
@@ -374,6 +393,8 @@ class ReplicaSet:
                     place=self._placed,
                     devices_per_replica=self.devices_per_replica,
                     ckpt_path=self.worker_ckpt,
+                    ckpt_use_ema=self.worker_use_ema,
+                    ckpt_quantize=self.worker_quantize,
                     heartbeat_interval_s=min(
                         max(self.heartbeat_s / 5, 0.01), 0.25),
                     rss_limit_mb=self.child_rss_limit_mb,

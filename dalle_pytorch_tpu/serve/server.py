@@ -63,6 +63,7 @@ class InferenceServer:
                  page_size: int = 0,
                  num_pages: int = 0,
                  paged_attn: str = "gather",
+                 sparse_reads: bool = False,
                  replicas: int = 1,
                  mesh_devices: int = 1,
                  heartbeat_s: float = 5.0,
@@ -73,6 +74,8 @@ class InferenceServer:
                  worker_cmd: Optional[str] = None,
                  attach_token: Optional[str] = None,
                  worker_ckpt: Optional[str] = None,
+                 worker_use_ema: bool = False,
+                 worker_quantize: str = "none",
                  clip_params: Optional[dict] = None, clip_cfg=None,
                  decode_images: bool = True,
                  metrics=None, log_every: int = 50,
@@ -140,12 +143,14 @@ class InferenceServer:
                 complete=self._on_decoded, metrics=metrics,
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
-                paged_attn=paged_attn,
+                paged_attn=paged_attn, sparse_reads=sparse_reads,
                 heartbeat_s=heartbeat_s, isolation=isolation,
                 child_rss_limit_mb=child_rss_limit_mb,
                 transport=transport, worker_endpoint=worker_endpoint,
                 worker_cmd=worker_cmd, attach_token=attach_token,
                 worker_ckpt=worker_ckpt,
+                worker_use_ema=worker_use_ema,
+                worker_quantize=worker_quantize,
                 devices_per_replica=self.mesh_devices)
         elif self.mesh_devices > 1:
             # ONE logical engine pjit-sharded over a device mesh — the
@@ -165,7 +170,7 @@ class InferenceServer:
                 complete=self._on_decoded, metrics=metrics,
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
-                paged_attn=paged_attn)
+                paged_attn=paged_attn, sparse_reads=sparse_reads)
         else:
             self.engine = engine_mod.Engine(
                 params, cfg, self.queue, num_slots=num_slots,
@@ -173,7 +178,7 @@ class InferenceServer:
                 complete=self._on_decoded, metrics=metrics,
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
-                paged_attn=paged_attn)
+                paged_attn=paged_attn, sparse_reads=sparse_reads)
 
         # bounded window: p50/p95 over the last 10k completions — an
         # unbounded list would grow (and re-sort under the lock) forever

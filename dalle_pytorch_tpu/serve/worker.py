@@ -95,7 +95,17 @@ def load_ckpt_params(spec: dict):
     ``checkpoint.validate`` — never trust a checkpoint that a partial
     rsync may have torn), or ``latest:<models_dir>:<name>`` resolved
     through ``checkpoint.latest_valid`` (newest epoch that validates —
-    the same trust rule auto-resume uses)."""
+    the same trust rule auto-resume uses).
+
+    The spec's serving TRANSFORMS then apply worker-side, in the same
+    order the in-process CLI applies them — ``ckpt_use_ema`` swaps in
+    the checkpoint's EMA weights (``cli.common.ema_as``, restored from
+    the SAME resolved directory; a checkpoint without EMA is a typed
+    rejection, exit 5), ``ckpt_quantize`` int8-quantizes the decode
+    path (``models.dalle.quantize_for_decode``) — so a checkpoint-path
+    attach serves weights byte-identical to ``--use_ema``/
+    ``--quantize`` applied on the parent, without those weights ever
+    crossing the wire."""
     from dalle_pytorch_tpu import checkpoint as ckpt
     from dalle_pytorch_tpu.utils.metrics import structured_event
 
@@ -121,6 +131,24 @@ def load_ckpt_params(spec: dict):
             raise WorkerCheckpointError(structured_event(
                 "serve_worker_ckpt_invalid", path=path, reason=reason))
     params, _manifest = ckpt.restore_params(path)
+    if spec.get("ckpt_use_ema"):
+        ema = ckpt.restore_ema(path)
+        if ema is None:
+            raise WorkerCheckpointError(structured_event(
+                "serve_worker_ckpt_invalid", path=path,
+                reason="spec asks for EMA weights but the checkpoint "
+                       "carries none (train with --ema_decay)"))
+        from dalle_pytorch_tpu.cli.common import ema_as
+        params = ema_as(ema, params)
+    quantize = str(spec.get("ckpt_quantize") or "none")
+    if quantize not in ("none", "int8", "int8_kv"):
+        raise WorkerCheckpointError(structured_event(
+            "serve_worker_ckpt_invalid", path=path,
+            reason=f"unknown ckpt_quantize {quantize!r} (expected "
+                   f"'none', 'int8', or 'int8_kv')"))
+    if quantize != "none":
+        from dalle_pytorch_tpu.models import dalle as D
+        params = D.quantize_for_decode(params)
     return params
 
 

@@ -406,6 +406,95 @@ class TestWorkerCheckpointSpec:
             ReplicaSet(params, CFG, RequestQueue(max_depth=4),
                        replicas=2, worker_ckpt="/tmp/x")
 
+    def test_worker_transforms_require_worker_ckpt(self, bundle):
+        """EMA/int8 transforms describe the worker's LOCAL load path;
+        without a ckpt-path spec they would silently do nothing."""
+        params, _ = bundle
+        with pytest.raises(ValueError, match="worker_ckpt"):
+            ReplicaSet(params, CFG, RequestQueue(max_depth=4),
+                       replicas=2, isolation="process",
+                       transport="socket", worker_use_ema=True)
+        with pytest.raises(ValueError, match="worker_quantize"):
+            ReplicaSet(params, CFG, RequestQueue(max_depth=4),
+                       replicas=2, isolation="process",
+                       transport="socket", worker_ckpt="/tmp/x",
+                       worker_quantize="fp4")
+
+    def test_load_ckpt_params_applies_worker_transforms(self, bundle):
+        """The PR-11 follow-up: a checkpoint-path spec carries
+        use_ema/quantize, and the worker applies them AFTER its local
+        load in the in-process CLI's order — weight trees identical to
+        ``ema_as``/``quantize_for_decode`` on the parent. A spec asking
+        for EMA from an EMA-less checkpoint is the typed rejection
+        (exit 5 downstream), not a KeyError."""
+        from dalle_pytorch_tpu import checkpoint as ckpt
+        from dalle_pytorch_tpu.cli.common import ema_as
+        from dalle_pytorch_tpu.serve.worker import (WorkerCheckpointError,
+                                                    load_ckpt_params)
+        params, _ = bundle
+        host = jax.tree.map(np.asarray, params)
+        ema = jax.tree.map(
+            lambda p: np.asarray(p, np.float32) * 1.25 + 0.01, host)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "w-1")
+            ckpt.save(path, host, ema=ema)
+            got = load_ckpt_params({"ckpt_path": path,
+                                    "ckpt_use_ema": True})
+            want = ema_as(ema, host)
+            jax.tree.map(np.testing.assert_array_equal, got, want)
+            got_q = load_ckpt_params({"ckpt_path": path,
+                                      "ckpt_quantize": "int8"})
+            want_q = D.quantize_for_decode(host)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b)), got_q, want_q)
+            with pytest.raises(WorkerCheckpointError, match="quantize"):
+                load_ckpt_params({"ckpt_path": path,
+                                  "ckpt_quantize": "fp4"})
+            # EMA-less checkpoint + EMA spec: typed, names the cause
+            path2 = os.path.join(d, "x-1")
+            ckpt.save(path2, host)
+            with pytest.raises(WorkerCheckpointError) as ei:
+                load_ckpt_params({"ckpt_path": path2,
+                                  "ckpt_use_ema": True})
+            assert ei.value.record["kind"] == "serve_worker_ckpt_invalid"
+            assert "EMA" in ei.value.record["reason"]
+
+    @pytest.mark.slow
+    def test_ckpt_attach_with_ema_serves_token_exact(self, bundle):
+        """End-to-end (spawned children, socket transport): workers
+        load the checkpoint locally AND apply the spec's EMA swap —
+        tokens byte-identical to an in-process engine serving
+        ``ema_as(ema, params)``."""
+        from dalle_pytorch_tpu import checkpoint as ckpt
+        from dalle_pytorch_tpu.cli.common import ema_as
+        params, _ = bundle
+        host = jax.tree.map(np.asarray, params)
+        ema = jax.tree.map(
+            lambda p: np.asarray(p, np.float32) * 1.25 + 0.01, host)
+        ema_params = ema_as(ema, host)
+        _, ref = engine_tokens(ema_params, Engine, K=8, reqs=REQS[:2])
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "w-0")
+            ckpt.save(path, host, ema=ema)
+            queue = RequestQueue(max_depth=16)
+            rs = ReplicaSet(params, CFG, queue, replicas=2, num_slots=2,
+                            chunk_steps=8, isolation="process",
+                            transport="socket", worker_ckpt=path,
+                            worker_use_ema=True,
+                            heartbeat_s=60.0, spawn_timeout_s=240.0,
+                            bringup_policy=FAST_BRINGUP)
+            try:
+                handles = [queue.submit(r) for r in REQS[:2]]
+                rs.run_until_idle(max_steps=2_000_000)
+                for h, want in zip(handles, ref):
+                    res = h.result(timeout=10)
+                    assert res.status == OK, (res.status, res.reason)
+                    np.testing.assert_array_equal(
+                        np.asarray(res.tokens), want)
+            finally:
+                rs.close()
+
     @pytest.mark.slow
     def test_ckpt_attach_serves_token_exact_and_bad_ckpt_is_typed(
             self, bundle):

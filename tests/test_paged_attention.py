@@ -360,6 +360,57 @@ class TestPagedViewTrim:
                    for e in consumers)
 
 
+class TestVisibilityOracle:
+    """ISSUE 12 oracle: the precomputed per-(layer, position) visible-
+    page set must agree EXACTLY with the dense ``_sparse_layout`` row
+    under the any-token-in-page reduction — for every position, across
+    page sizes and both sparse layout shapes the repo serves (the
+    reference block-16 VariableSparsity and the tighter block-4 layout
+    the sparse-reads tests/bench use)."""
+
+    @pytest.mark.parametrize("page_size", [8, 16])
+    @pytest.mark.parametrize("block,num_local_blocks",
+                             [(16, 4), (4, 4), (4, 2)])
+    def test_visible_pages_matches_layout_row_reduction(
+            self, page_size, block, num_local_blocks):
+        from dalle_pytorch_tpu.ops import sparse as sparse_ops
+        L = 108
+        vis, cnt = sparse_ops.visible_pages(
+            L, page_size, block, num_local_blocks=num_local_blocks)
+        padded = ((L + block - 1) // block) * block
+        layout = sparse_ops.token_layout_mask(
+            padded, block, num_local_blocks=num_local_blocks)[:L, :L]
+        for p in range(L):
+            want = sorted({t // page_size for t in range(L)
+                           if layout[p, t]})
+            got = list(vis[p, :cnt[p]])
+            assert got == want, (p, got, want)
+            # padding entries are zeros, never visibility grants
+            assert (vis[p, cnt[p]:] == 0).all()
+        # ascending order is load-bearing: the kernel's online-softmax
+        # walk and the causal prefix trim both assume it
+        assert all(list(vis[p, :cnt[p]])
+                   == sorted(vis[p, :cnt[p]]) for p in range(L))
+
+    @pytest.mark.parametrize("page_size", [8, 16])
+    def test_causal_trip_counts(self, page_size):
+        """``_sparse_page_visibility``'s decode trip count: the prefix
+        of visible pages starting strictly before p — page g readable
+        iff g*ps < p (its first row is cached), matching the prefix
+        walk's ceil(pos/ps) raggedness page-for-page."""
+        from dalle_pytorch_tpu.ops import decode as dec
+        L = CFG.seq_len
+        cfg = D.DALLEConfig(dim=16, depth=2, vae=VCFG,
+                            num_text_tokens=50, text_seq_len=8, heads=2,
+                            dim_head=8, sparse_attn=(True, False),
+                            sparse_block=4).transformer
+        vis, cnt, ccnt = dec._sparse_page_visibility(cfg, L, page_size)
+        for p in range(L):
+            want = sum(1 for g in vis[p, :cnt[p]] if g * page_size < p)
+            assert ccnt[p] == want
+        assert ccnt[0] == 0      # a parked dead slot walks zero pages
+
+
 class TestReadBytesModel:
     def test_kernel_model_reads_fewer_bytes_than_gather(self):
         """The analytic model bench_serve records: the kernel's
@@ -377,3 +428,30 @@ class TestReadBytesModel:
         assert k2 == pytest.approx(g2, rel=0.02)
         with pytest.raises(ValueError, match="impl"):
             PA.modeled_kv_read_bytes_per_token(impl="x", **common)
+
+    def test_sparse_reads_model_undercuts_dense_reads(self):
+        """The sparse-reads model: sparse layers read only visible
+        pages, dense layers unchanged — so bytes drop for both impls,
+        by more when more layers are sparse, and the sparse pattern is
+        required (silently modeling a dense stack as sparse would fake
+        the win)."""
+        common = dict(depth=2, heads=2, dim_head=16, total_len=108,
+                      page_size=8, prompt_len=4, itemsize=2,
+                      sparse_block=4)
+        for impl in ("gather", "kernel"):
+            dense = PA.modeled_kv_read_bytes_per_token(impl=impl,
+                                                       **common)
+            half = PA.modeled_kv_read_bytes_per_token(
+                impl=impl, sparse_reads=True,
+                sparse_pattern=(True, False), **common)
+            full = PA.modeled_kv_read_bytes_per_token(
+                impl=impl, sparse_reads=True,
+                sparse_pattern=(True, True), **common)
+            assert full < half < dense, (impl, full, half, dense)
+            # the all-sparse block-4 layout sees <= 3 of 14 pages: the
+            # acceptance-criterion ratio holds with margin
+            assert full <= 0.5 * dense, (impl, full, dense)
+        with pytest.raises(ValueError, match="sparse_pattern"):
+            PA.modeled_kv_read_bytes_per_token(impl="kernel",
+                                               sparse_reads=True,
+                                               **common)
