@@ -1608,6 +1608,188 @@ def _serve_sparse_reads_compare(*, num_slots=2, chunk_steps=8):
     return out
 
 
+def _serve_prefix_compare(*, num_slots=4, chunk_steps=8, n_samples=4):
+    """Cold vs WARM admission over the prefix cache, plus the guided-
+    pair cost — the record ISSUE 13's acceptance names. One paged
+    prefix-cache engine and one prefix-blind reference engine (both
+    compiled once), asserted legs:
+
+      * ``fanout``: N samples of one prompt admitted together allocate
+        the shared prompt span ONCE — peak physical pages <= pages(1
+        request) + N x pages(private span), strictly under the
+        refcount-blind engine's measured peak — every stream
+        byte-identical to its cold reference;
+      * ``warm_prefill``: p50 warm-admission wall time <= 0.1x the p50
+        cold prefill dispatch (both timed to completion via the
+        engine's ``time_admissions``, compiles excluded) and ZERO
+        prefill dispatches across the warm storm. The config is sized
+        so the prompt forward genuinely dominates dispatch overhead
+        (dim 256 x depth 4 x 32-token prompts) — on a tiny config the
+        ratio would measure the runtime, not the cache;
+      * ``cfg_pair``: a guided request (cond/uncond pair) against a
+        warmed index allocates < 2x the pages of a plain request's
+        full map and runs < 2x its ms/token — the prompt and the null
+        caption are both shared spans, so only the generated span pays
+        double.
+
+    All CPU-safe: pages, dispatch counts, and admission wall time are
+    the asserted quantities — not kernel ms/token — so this asserts
+    everywhere, not just on real TPU."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.models import dalle as D
+    from dalle_pytorch_tpu.models import vae as V
+    from dalle_pytorch_tpu.serve import Request, RequestQueue, pages_for
+    from dalle_pytorch_tpu.serve.engine import Engine
+
+    vcfg = V.VAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                       num_layers=2, hidden_dim=8)
+    cfg = D.DALLEConfig(dim=256, depth=4, vae=vcfg, num_text_tokens=64,
+                        text_seq_len=32, heads=4, dim_head=64)
+    params = jax.device_put(D.dalle_init(jax.random.PRNGKey(0), cfg,
+                                         dtype=jnp.bfloat16))
+    page_size = 8
+    prompt = tuple(1 + (i % 7) for i in range(cfg.text_seq_len))
+    t0 = len(prompt)
+    full = pages_for(cfg.seq_len, page_size)
+    shared_full = t0 // page_size
+    slots = max(num_slots, n_samples)
+    out = {"page_size": page_size, "chunk_steps": chunk_steps,
+           "prompt_len": t0, "seq_len": cfg.seq_len,
+           "n_samples": n_samples, "num_slots": slots, "asserted": True}
+
+    def build(prefix_cache):
+        queue = RequestQueue(max_depth=4 * slots + 8)
+        engine = Engine(params, cfg, queue, num_slots=slots,
+                        chunk_steps=chunk_steps, kv="paged",
+                        page_size=page_size, prefix_cache=prefix_cache,
+                        time_admissions=True)
+        return engine, queue
+
+    def run(engine, queue, reqs):
+        handles = [queue.submit(r) for r in reqs]
+        t_start = time.perf_counter()
+        engine.run_until_idle()
+        wall = time.perf_counter() - t_start
+        toks = []
+        for h in handles:
+            res = h.result(timeout=300)
+            if res.status != "ok":
+                raise AssertionError(
+                    f"prefix_compare request failed: {res.status} "
+                    f"{res.reason}")
+            toks.append(np.asarray(res.tokens))
+        return toks, wall
+
+    engine, queue = build(prefix_cache=True)
+    ref_engine, ref_queue = build(prefix_cache=False)
+
+    # -- fanout FIRST (clean lifetime peaks on both engines) ------------
+    _progress(f"prefix: {n_samples}-sample fan-out of one prompt "
+              f"(compiles the four programs)")
+    reqs = [Request(codes=prompt, seed=s) for s in range(n_samples)]
+    toks, _ = run(engine, queue, reqs)
+    want, _ = run(ref_engine, ref_queue, reqs)
+    mism = sum(not np.array_equal(a, b) for a, b in zip(toks, want))
+    if mism:
+        raise AssertionError(
+            f"fanout: {mism} of {n_samples} shared-prompt streams "
+            f"diverged from their cold runs")
+    bound = full + n_samples * (full - shared_full)
+    peak, blind = engine.alloc.peak_in_use, ref_engine.alloc.peak_in_use
+    if peak > bound:
+        raise AssertionError(
+            f"fanout peak {peak} pages > bound {bound} (pages(1 "
+            f"request) + N x pages(private span)) — the shared span "
+            f"must be allocated once")
+    if peak >= blind:
+        raise AssertionError(
+            f"fanout peak {peak} pages >= the refcount-blind engine's "
+            f"{blind} — sharing saved nothing")
+    out["fanout"] = {"peak_pages": peak, "peak_pages_bound": bound,
+                     "peak_pages_blind": blind,
+                     "pages_shared": shared_full,
+                     "token_mismatches": 0}
+
+    # -- warm_prefill: timed cold storm, then a same-prompt warm storm --
+    _progress("prefix: timed cold prefills vs warm admissions")
+    cold_reqs = [Request(codes=tuple((1 + i + j) % 7 + 1
+                                     for j in range(t0)), seed=i)
+                 for i in range(3)]
+    for r in cold_reqs:
+        run(engine, queue, [r])
+    runs_before = engine.prefill_runs
+    warm_reqs = [Request(codes=cold_reqs[-1].codes, seed=100 + i)
+                 for i in range(4)]
+    warm_toks = [run(engine, queue, [r])[0][0] for r in warm_reqs]
+    if engine.prefill_runs != runs_before:
+        raise AssertionError(
+            f"warm storm dispatched {engine.prefill_runs - runs_before} "
+            f"prefills — warm admission must run zero")
+    for r, got in zip(warm_reqs, warm_toks):
+        want_r, _ = run(ref_engine, ref_queue, [r])
+        if not np.array_equal(got, want_r[0]):
+            raise AssertionError(
+                f"warm-hit tokens diverged from the cold run "
+                f"(seed {r.seed})")
+    stats = engine.stats()
+    cold_p50 = stats["prefill_p50_ms"]
+    warm_p50 = stats["warm_admit_p50_ms"]
+    if warm_p50 > 0.1 * cold_p50:
+        raise AssertionError(
+            f"warm admission p50 {warm_p50}ms > 0.1x cold prefill p50 "
+            f"{cold_p50}ms — the warm path must skip the prompt "
+            f"forward entirely")
+    out["warm_prefill"] = {
+        "cold_prefill_p50_ms": cold_p50,
+        "warm_admit_p50_ms": warm_p50,
+        "speedup": round(cold_p50 / max(warm_p50, 1e-6), 1),
+        "prefix_hits": stats["prefix_hits"],
+        "prefill_runs": stats["prefill_runs"],
+        "token_mismatches": 0,
+    }
+
+    # -- cfg_pair: guided vs plain on the warmed index ------------------
+    _progress("prefix: guided-pair page/latency cost vs plain")
+    run(engine, queue, [Request(codes=(0,) * t0, seed=1)])  # null entry
+    run(engine, queue, [Request(codes=prompt, seed=7, cfg_scale=2.0)])
+    allocs0 = engine.alloc.allocs
+    _, plain_wall = run(engine, queue, [Request(codes=prompt, seed=8)])
+    plain_fresh = engine.alloc.allocs - allocs0
+    allocs1 = engine.alloc.allocs
+    _, cfg_wall = run(engine, queue,
+                      [Request(codes=prompt, seed=9, cfg_scale=2.0)])
+    cfg_fresh = engine.alloc.allocs - allocs1
+    tokens_per_req = cfg.seq_len - t0
+    plain_ms = 1e3 * plain_wall / tokens_per_req
+    cfg_ms = 1e3 * cfg_wall / tokens_per_req
+    # pages: what the pair newly ALLOCATES (shared spans cost zero
+    # fresh pages) vs a plain request's full map — strictly under 2x
+    if cfg_fresh >= 2 * full:
+        raise AssertionError(
+            f"guided pair allocated {cfg_fresh} fresh pages >= 2x a "
+            f"plain request's {full} — the prompt/null spans must "
+            f"share physically")
+    if cfg_ms >= 2 * plain_ms:
+        raise AssertionError(
+            f"guided ms/token {cfg_ms:.3f} >= 2x plain "
+            f"{plain_ms:.3f} — the pair rides the same fused chunks")
+    out["cfg_pair"] = {
+        "plain_ms_per_token": round(plain_ms, 4),
+        "cfg_ms_per_token": round(cfg_ms, 4),
+        "ms_ratio": round(cfg_ms / max(plain_ms, 1e-9), 3),
+        "plain_pages_full": full,
+        "plain_fresh_pages": int(plain_fresh),
+        "cfg_fresh_pages": int(cfg_fresh),
+        "pages_ratio": round(cfg_fresh / full, 3),
+        "cfg_pairs": engine.cfg_pairs,
+    }
+    return out
+
+
 def _serve_replica_compare(params, cfg, *, replicas, num_slots, n_req,
                            kv, page_size, chunk_steps=8):
     """The replica-set headline: N supervised engines behind one queue
@@ -2294,6 +2476,16 @@ def bench_serve(args):
         sparse_compare = {"error": f"{type(e).__name__}: {e}"}
         errors.append(str(e))
 
+    _progress("serve: prefix-cache warm-vs-cold + guided-pair cost "
+              "comparison")
+    try:
+        prefix_compare = _serve_prefix_compare(
+            num_slots=min(num_slots, 4))
+    except Exception as e:  # noqa: BLE001 — same structured-error
+        # contract: the serve-perf prefix_cache CI leg greps for it
+        prefix_compare = {"error": f"{type(e).__name__}: {e}"}
+        errors.append(str(e))
+
     replica_compare = None
     if args.replicas > 1:
         _progress(f"serve: {args.replicas}-replica scaling + "
@@ -2367,6 +2559,7 @@ def bench_serve(args):
         "kv_budget_compare": kv_compare,
         "paged_attn_compare": pa_compare,
         "sparse_reads_compare": sparse_compare,
+        "prefix_compare": prefix_compare,
         "devices": len(jax.devices()), "backend": jax.default_backend(),
     }
     if mesh_compare is not None:

@@ -99,6 +99,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "weight); per-token KV read traffic drops by "
                         "the visibility ratio (docs/SERVING.md 'Sparse "
                         "decode reads')")
+    p.add_argument("--prefix_cache", action="store_true",
+                   help="cross-request prefix cache (requires --kv "
+                        "paged): prompt KV pages become refcounted, "
+                        "copy-on-write, content-addressed — a repeated "
+                        "prompt (retry storm, shared style prefix, "
+                        "N samples per prompt) admits WARM: its prompt "
+                        "pages map into the new request's block table "
+                        "physically (zero prefill FLOPs, zero new pages "
+                        "for the shared span) and only the generated "
+                        "span allocates. Sharing is read-only by "
+                        "construction; under page pressure the LRU end "
+                        "of the index is dropped before any live "
+                        "request is evicted (docs/SERVING.md 'Prefix "
+                        "cache & per-request CFG')")
+    p.add_argument("--cfg_scale", type=float, default=0.0,
+                   help="default classifier-free guidance scale for "
+                        "requests that don't carry their own "
+                        "(POST /generate {\"cfg_scale\": ...} "
+                        "overrides per request; 0 = unguided). A "
+                        "guided request runs a cond/uncond slot pair "
+                        "whose image tokens sample from l_u + "
+                        "scale*(l_c - l_u) — gen_dalle's --guidance, "
+                        "per request. With --prefix_cache the pair "
+                        "shares its prompt pages physically (the null "
+                        "caption is ONE cache entry for all guided "
+                        "traffic), so guidance costs < 2x pages. "
+                        "Train with --caption_drop so the model has "
+                        "seen null captions")
     p.add_argument("--num_pages", type=int, default=0,
                    help="physical pages in the pool incl. the reserved "
                         "trash page (paged mode; 0 = fully provisioned: "
@@ -291,6 +319,8 @@ def main(argv=None):
         quantize_cache=args.quantize == "int8_kv",
         kv=args.kv, page_size=args.page_size, num_pages=args.num_pages,
         paged_attn=args.paged_attn, sparse_reads=args.sparse_reads,
+        prefix_cache=args.prefix_cache,
+        default_cfg_scale=args.cfg_scale,
         replicas=args.replicas, mesh_devices=args.mesh_devices,
         heartbeat_s=args.heartbeat_s,
         isolation=args.isolation,
@@ -306,7 +336,10 @@ def main(argv=None):
         init_retries=args.init_retries).start()
     kv_desc = args.kv if args.kv == "dense" \
         else f"{args.kv}/{args.paged_attn}" \
-        + ("/sparse_reads" if args.sparse_reads else "")
+        + ("/sparse_reads" if args.sparse_reads else "") \
+        + ("/prefix_cache" if args.prefix_cache else "")
+    if args.cfg_scale > 0:
+        kv_desc += f", cfg_scale={args.cfg_scale:g}"
     iso_desc = args.isolation if args.transport == "pipe" \
         else f"{args.isolation}/{args.transport}"
     mesh_desc = "" if args.mesh_devices <= 1 \

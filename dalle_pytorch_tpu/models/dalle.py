@@ -414,7 +414,10 @@ def top_p_filter(logits: Array, p: float) -> Array:
 
 def sample_per_slot(logits: Array, pred_pos: Array, keys: Array,
                     temp: Array, topk_k: Array, top_p: Array,
-                    cfg: DALLEConfig) -> Array:
+                    cfg: DALLEConfig, *,
+                    partner: Optional[Array] = None,
+                    cfg_scale: Optional[Array] = None,
+                    uncond: Optional[Array] = None) -> Array:
     """Per-slot sampling: the traced-parameter form of ``generate_images``'s
     ``sample`` — forbidden-position mask, temperature, top-k OR nucleus
     filter, categorical — with every knob a (slots,) array instead of a
@@ -432,10 +435,34 @@ def sample_per_slot(logits: Array, pred_pos: Array, keys: Array,
     and ``jax.random.categorical`` over one slot's (vocab,) row equals
     the batch-1 call with the same key. Returns sampled ids with the
     text-vocab offset removed for image positions, as ``generate_images``
-    stores them."""
+    stores them.
+
+    ``partner``/``cfg_scale``/``uncond`` (all (slots,); pass together or
+    not at all) fold per-request classifier-free guidance into the SAME
+    program: a guided request occupies a cond/uncond slot pair (each the
+    other's ``partner``; self elsewhere), and a cond slot with
+    ``cfg_scale > 0`` samples image positions from
+    ``l_uncond + cfg_scale * (l_cond - l_uncond)`` — the identical
+    formula, f32 mix, and cast of ``generate_images``' guided ``sample``
+    — while its uncond partner takes the cond slot's drawn token (the
+    one-shot path's ``tile``), so the pair's caches stay in step. Text
+    positions sample from the cond stream alone, exactly as one-shot."""
     forbidden = logits_mask(cfg)
     lg = jnp.where(jnp.take(forbidden, pred_pos - 1, axis=0),
                    core.neg_inf(logits.dtype), logits)
+    if partner is not None:
+        # guided mix BEFORE temperature, on the masked logits — the
+        # one-shot ``sample``'s order. f32: the forbidden fill is
+        # -finfo.max and the extrapolation must not overflow it.
+        l_self = lg.astype(jnp.float32)
+        l_pair = jnp.take(lg, partner, axis=0).astype(jnp.float32)
+        # on a cond slot the partner IS the uncond stream: the mix
+        # below is literally l_u + scale * (l_c - l_u)
+        mix = (l_pair + cfg_scale[:, None] * (l_self - l_pair)) \
+            .astype(lg.dtype)
+        guided_img = ((cfg_scale > 0) & ~uncond
+                      & (pred_pos >= cfg.text_seq_len))
+        lg = jnp.where(guided_img[:, None], mix, lg)
     lg = lg / temp[:, None]
 
     sorted_desc = jnp.flip(jnp.sort(lg, axis=-1), axis=-1)
@@ -453,6 +480,12 @@ def sample_per_slot(logits: Array, pred_pos: Array, keys: Array,
     lg = jnp.where((top_p > 0)[:, None], by_p, by_k)
     folded = jax.vmap(jax.random.fold_in)(keys, pred_pos)
     raw = jax.vmap(jax.random.categorical)(folded, lg)
+    if partner is not None:
+        # the uncond slot takes its cond partner's drawn token — the
+        # one-shot guided path's ``tile(raw, 2)``: both streams of a
+        # pair consume the same token so their KV caches agree
+        raw = jnp.where((cfg_scale > 0) & uncond,
+                        jnp.take(raw, partner), raw)
     is_image = pred_pos >= cfg.text_seq_len
     return jnp.where(is_image, raw - cfg.num_text_tokens, raw)
 

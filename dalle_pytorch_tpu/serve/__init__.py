@@ -6,7 +6,9 @@ types (Request/Result/QueueFull) are importable before a backend exists —
 the same discipline as ``resilience`` (utils/metrics.py note)."""
 
 from dalle_pytorch_tpu.serve.kv_pool import (  # noqa: F401
-    PageAllocator, PagePoolExhausted, pages_for)
+    PageAllocator, PagePoolExhausted, PageReleaseUnderflow, pages_for)
+from dalle_pytorch_tpu.serve.prefix_cache import (  # noqa: F401
+    PrefixEntry, PrefixIndex, prefix_key)
 from dalle_pytorch_tpu.serve.scheduler import (  # noqa: F401
     CANCELLED, DEADLINE_EXCEEDED, ERROR, OK, REJECTED, InvalidRequest,
     QueueClosed, QueueFull, Request, RequestHandle, RequestQueue, Result,

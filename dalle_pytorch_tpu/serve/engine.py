@@ -65,9 +65,39 @@ re-admission). The steady-state loop stays in the identical one-compile,
 transfer-clean, emit-ring regime: the only paged-specific host traffic
 is an explicit ``device_put`` of the tiny block table when it changes.
 
-Not supported per-request: classifier-free guidance (it doubles the
-stream per request; serve a guidance-dedicated engine instead) and padded
-prompt masks (requests carry unpadded codes, gen_dalle's default mode).
+Cross-request prefix cache (``prefix_cache=True``, paged only): prompt
+KV pages become a refcounted, copy-on-write, content-addressed resource
+(``serve/prefix_cache.py`` + the refcounted ``PageAllocator``). On
+admission, a prompt whose key is indexed takes the WARM path: the
+entry's full prompt pages map straight into the new slot's block table
+(refcount++ — zero prefill FLOPs, zero new pages for the shared span),
+the partial boundary page is forked copy-on-write from the entry's
+device snapshot into one private page, and the first token is sampled
+from the entry's cached last hidden row by a tiny warm-admission
+program (one ``to_logits`` + per-slot sample, compiled once, ever).
+Sharing is read-only BY CONSTRUCTION: shared pages lie wholly below the
+prompt length t0, and decode only ever appends at positions >= t0 —
+asserted at every warm mapping. Slot teardown releases references;
+pages return to the free list only at refcount zero, so an eviction
+victim can never hand a sibling's mapped page to the next allocation.
+Under page pressure the LRU end of the index is dropped BEFORE any live
+request is evicted.
+
+Per-request classifier-free guidance (``Request.cfg_scale > 0``): the
+request admits a cond/uncond SLOT PAIR — the uncond member is a shadow
+slot running the all-PAD null caption — and the guided logit mix
+``l_u + scale * (l_c - l_u)`` is folded into the fused decode program
+itself (``models.dalle.sample_per_slot``'s partner/cfg_scale/uncond
+arguments), so ``decode_traces == 1`` still holds and the pair's tokens
+are byte-identical to ``generate_images(guidance=scale)``. With the
+prefix cache on, the pair shares every cacheable prompt span physically
+(the null caption is ONE entry shared by all guided requests of a given
+prompt length) and diverges copy-on-write only over the generated span
+— which is what makes per-request guidance affordable: < 2x pages, not
+2x everything.
+
+Not supported per-request: padded prompt masks (requests carry unpadded
+codes, gen_dalle's default mode).
 
 The engine is deliberately single-threaded and drivable iteration-by-
 iteration (``step_once`` = expire/admit/dispatch-one-chunk/harvest-one)
@@ -92,21 +122,33 @@ from dalle_pytorch_tpu.serve import scheduler as S
 # reads the SAME set and cannot drift from stats()
 COUNTERS = ("tokens_decoded", "decode_steps", "harvests",
             "occupancy_sum", "completed", "expired",
-            "decode_traces", "prefill_traces", "evicted")
+            "decode_traces", "prefill_traces", "evicted",
+            "prefix_hits", "cfg_pairs")
 
 
 class _Slot:
     """Host-side bookkeeping for one slot of the pool. Decode state
     (position, current token) lives on device; the host only accumulates
-    harvested tokens against the handle."""
+    harvested tokens against the handle.
 
-    __slots__ = ("handle", "t0", "emitted", "t_admit")
+    A classifier-free-guidance pair is two slots: the cond slot carries
+    ``pair`` (its uncond partner's index) and the uncond SHADOW slot
+    carries ``shadow_of`` (the cond index) — the shadow holds the same
+    handle but is never credited, completed, or evicted on its own; it
+    lives and dies with its cond slot."""
 
-    def __init__(self, handle: S.RequestHandle, t0: int, t_admit: float):
+    __slots__ = ("handle", "t0", "emitted", "t_admit", "pair",
+                 "shadow_of")
+
+    def __init__(self, handle: S.RequestHandle, t0: int, t_admit: float,
+                 pair: Optional[int] = None,
+                 shadow_of: Optional[int] = None):
         self.handle = handle
         self.t0 = t0
         self.emitted: List[int] = []
         self.t_admit = t_admit
+        self.pair = pair
+        self.shadow_of = shadow_of
 
 
 class _Chunk:
@@ -122,6 +164,47 @@ class _Chunk:
         self.ring = ring
         self.active = active
         self.owners = owners
+
+
+def _p50_ms(samples: List[float]) -> float:
+    """Nearest-rank p50 of a list of wall-seconds, in ms (0.0 when
+    empty) — the admission-timing surface bench's prefix_compare
+    asserts warm-vs-cold prefill cost on."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return round(1e3 * s[min(len(s) // 2, len(s) - 1)], 4)
+
+
+class _Row:
+    """One SLOT's worth of admission plan. A plain request is one row; a
+    guided request is two (cond + uncond shadow, ``pair_row`` linking
+    them). ``mode`` is the prefix-cache disposition: ``cold`` runs the
+    bucket prefill; ``warm`` maps an indexed entry's pages (zero prefill
+    FLOPs); ``warm_pending`` is a warm-after — its key is being
+    prefilled by an earlier cold row of the SAME admission (the
+    N-samples-of-one-prompt fan-out), so it resolves against the index
+    after the cold groups land."""
+
+    __slots__ = ("handle", "codes", "uncond", "pair_row", "t0", "bucket",
+                 "total_pages", "mode", "shared_n", "key", "entry",
+                 "grants", "slot", "group_idx")
+
+    def __init__(self, handle: S.RequestHandle, codes, uncond: bool):
+        self.handle = handle
+        self.codes = codes
+        self.uncond = uncond
+        self.pair_row: Optional["_Row"] = None
+        self.t0 = len(codes)
+        self.bucket = 0
+        self.total_pages = 0
+        self.mode = "cold"
+        self.shared_n = 0
+        self.key: Optional[str] = None
+        self.entry = None
+        self.grants: List[int] = []
+        self.slot = -1
+        self.group_idx = -1
 
 
 class Engine:
@@ -141,6 +224,10 @@ class Engine:
                  num_pages: int = 0,
                  paged_attn: str = "gather",
                  sparse_reads: bool = False,
+                 prefix_cache: bool = False,
+                 prefix_entries: int = 256,
+                 model_version: str = "0",
+                 time_admissions: bool = False,
                  clock: Callable[[], float] = time.perf_counter,
                  device=None):
         import jax
@@ -296,6 +383,12 @@ class Engine:
             self._min_admit_pages = KV.pages_for(min(self.buckets),
                                                  self.page_size)
         else:
+            if prefix_cache:
+                raise ValueError(
+                    "prefix_cache requires kv='paged' — physical prompt "
+                    "sharing lives in the page pool's block-table "
+                    "indirection; the dense slot cache has neither "
+                    "pages nor refcounts")
             self.cache = self._place_kv(decode_ops.init_cache(
                 cfg.transformer, S_, self.total_len,
                 dtype=params["text_emb"]["w"].dtype,
@@ -314,7 +407,38 @@ class Engine:
             jnp.ones((S_,), jnp.float32),
             jnp.ones((S_,), jnp.int32),
             jnp.zeros((S_,), jnp.float32)))
+        # classifier-free-guidance pair state: per-slot partner index
+        # (self when unpaired), guidance scale (0 = off — the mix and
+        # the partner-copy in sample_per_slot are exact identities
+        # then), and the uncond-shadow flag. Host-authoritative like the
+        # block tables: admission/teardown edit the host arrays and one
+        # explicit device_put pushes them before the next chunk.
+        self._cfg_partner_host = np.arange(S_, dtype=np.int32)
+        self._cfg_scale_host = np.zeros((S_,), np.float32)
+        self._cfg_uncond_host = np.zeros((S_,), bool)
+        (self.cfg_partner, self.cfg_scale,
+         self.cfg_uncond) = self._place_state((
+             jnp.arange(S_, dtype=jnp.int32),
+             jnp.zeros((S_,), jnp.float32),
+             jnp.zeros((S_,), bool)))
+        self._cfg_dirty = False
         self.slots: List[Optional[_Slot]] = [None] * S_
+        # the prefix cache (kv='paged' only): content-addressed prompt
+        # KV sharing over the refcounted allocator
+        self.model_version = str(model_version)
+        self.prefix = None
+        if prefix_cache:
+            from dalle_pytorch_tpu.serve import prefix_cache as PC
+            self.prefix = PC.PrefixIndex(self.alloc,
+                                         max_entries=prefix_entries)
+            self._layer_sig = PC.layer_signature(cfg.transformer)
+        # admission timing (bench's prefix_compare reads these): wall
+        # seconds per cold prefill dispatch / warm admission, measured
+        # to completion (block_until_ready) — off by default, because
+        # the block is a host sync admission doesn't otherwise need
+        self.time_admissions = bool(time_admissions)
+        self.prefill_times: List[float] = []
+        self.warm_admit_times: List[float] = []
         self._pending: deque = deque()   # dispatched, un-harvested chunks
         # memo for the config-static /stats read-bytes model, keyed by
         # the sparse_reads flag it was asked for
@@ -325,7 +449,16 @@ class Engine:
         self.prefill_traces = 0         # fixed-shape contract keeps the
         #                                 decode program at 1 and prefill
         #                                 at 1 per bucket
+        self.warm_admit_traces = 0      # the warm-admission program: 1,
+        #                                 ever (no bucket dependence)
         self._prefill_trace_counts: Dict[int, int] = {}
+        self.prefill_runs = 0           # prefill DISPATCHES (a warm hit
+        #                                 runs zero of these)
+        self.warm_admits = 0            # requests admitted zero-FLOP
+        self.prefix_hits = 0            # warm admissions (engine-level:
+        #                                 counted when the hit is USED,
+        #                                 not merely probed)
+        self.cfg_pairs = 0              # guided pairs admitted
         self.decode_steps = 0           # fused steps dispatched (chunks*K)
         self.harvests = 0               # emit-ring device_gets — the ONLY
         #                                 host syncs in steady state
@@ -372,6 +505,20 @@ class Engine:
         self._decode_fn = self._jit_decode(impl, donate)
         self._kill_fn = jax.jit(lambda active, keep: active & keep)
         self._prefill_fns: Dict = {}
+        self._warm_fn = None            # built lazily (prefix_cache)
+        if self.prefix is not None:
+            from dalle_pytorch_tpu.serve import kv_pool as KV
+            # the copy-on-write pair: snapshot one physical page at
+            # prefix insert, fork it into a warm consumer's private
+            # page. Pool updates go through the _jit_pool_update hook
+            # so a mesh engine can pin the KV shardings — an unpinned
+            # restore that drifted the pool's placement would silently
+            # retrace the fused decode program (decode_traces catches
+            # it, but pin instead of hope).
+            self._snap_fn = self._jit_pool_read(
+                lambda pool, pid: KV.snapshot_page(pool, pid))
+            self._restore_fn = self._jit_pool_update(
+                lambda pool, pid, snap: KV.restore_page(pool, pid, snap))
         self._lock = threading.Lock()   # step_once is not reentrant
 
     # -- placement hooks (the mesh seam: serve/mesh_engine.py) --------------
@@ -414,6 +561,24 @@ class Engine:
         import jax
         return jax.jit(pre)
 
+    def _jit_warm_program(self, warm):
+        """The warm-admission program (prefix cache): same jit seam as
+        prefill — the mesh engine pins replicated output shardings so
+        the per-slot state's placement can never drift."""
+        import jax
+        return jax.jit(warm)
+
+    def _jit_pool_read(self, fn):
+        """Page snapshot (prefix insert): pool -> one page's rows."""
+        import jax
+        return jax.jit(fn)
+
+    def _jit_pool_update(self, fn):
+        """Page restore (COW fork): returns the UPDATED pool, so a mesh
+        engine must pin the pool's shardings on the output."""
+        import jax
+        return jax.jit(fn)
+
     def _logits_sync(self, logits):
         """Traced hook over the per-step logits, identity here. The mesh
         engine re-replicates here: its logits head is vocab-sharded
@@ -430,25 +595,52 @@ class Engine:
 
     # -- jitted programs ----------------------------------------------------
 
-    def _decode_impl(self, params, cache, cur_tok, pos, active, keys, temp,
-                     topk_k, top_p):
-        """The fused steady-state program: ``chunk_steps`` decode steps
-        for ALL slots in one ``lax.scan`` (``ops.decode.decode_loop``),
-        emitted tokens collected into the device-side (num_slots, K)
-        ring. Traced exactly once (fixed shapes) — the side-effecting
-        counter below proves it."""
-        self.decode_traces += 1
+    def _cfg_closures(self, params, keys, temp, topk_k, top_p, partner,
+                      cfgs, uncond):
+        """The embed/sample closures BOTH fused decode programs share,
+        with per-request classifier-free guidance folded in: a guided
+        pair's cond slot samples image positions from the mixed logits
+        (its partner's row is the uncond stream — the gather happens on
+        the replicated post-``_logits_sync`` logits, so the mesh
+        engine's vocab sharding never reorders the mix), the uncond
+        shadow copies its partner's drawn token, and the shadow's TEXT
+        positions embed PAD — ``generate_images``' guided scan
+        verbatim. With every scale at 0 (no guided request in the
+        pool) each extra op is an exact identity, so the unguided
+        byte-identity contract is untouched."""
+        import jax.numpy as jnp
+
         from dalle_pytorch_tpu.models import dalle as D
-        from dalle_pytorch_tpu.ops import decode as decode_ops
 
         def embed_fn(tok, p):
+            # the null stream's text stays PAD — feeding it the sampled
+            # caption would make it conditional (one-shot: cur_tok =
+            # where(is_text & uncond_rows, 0, cur_tok))
+            tok = jnp.where(uncond & (p < self.cfg.text_seq_len), 0, tok)
             return D.decode_token_embed(params, self.cfg, tok, p)
 
         def sample_fn(h, pred_pos):
             logits = self._logits_sync(D.to_logits(params, h))
             return D.sample_per_slot(logits, pred_pos, keys, temp,
-                                     topk_k, top_p, self.cfg)
+                                     topk_k, top_p, self.cfg,
+                                     partner=partner, cfg_scale=cfgs,
+                                     uncond=uncond)
 
+        return embed_fn, sample_fn
+
+    def _decode_impl(self, params, cache, cur_tok, pos, active, keys, temp,
+                     topk_k, top_p, partner, cfgs, uncond):
+        """The fused steady-state program: ``chunk_steps`` decode steps
+        for ALL slots in one ``lax.scan`` (``ops.decode.decode_loop``),
+        emitted tokens collected into the device-side (num_slots, K)
+        ring. Traced exactly once (fixed shapes) — the side-effecting
+        counter below proves it; the guidance-pair state rides as three
+        more (num_slots,) arrays, never a new trace."""
+        self.decode_traces += 1
+        from dalle_pytorch_tpu.ops import decode as decode_ops
+
+        embed_fn, sample_fn = self._cfg_closures(
+            params, keys, temp, topk_k, top_p, partner, cfgs, uncond)
         return decode_ops.decode_loop(
             params["transformer"], cur_tok, pos, active, cache,
             cfg=self.cfg.transformer, key_mask=self.key_mask,
@@ -456,7 +648,8 @@ class Engine:
             out_sync=self._decode_out_sync())
 
     def _decode_impl_paged(self, params, cache, block_tables, cur_tok, pos,
-                           active, keys, temp, topk_k, top_p):
+                           active, keys, temp, topk_k, top_p, partner,
+                           cfgs, uncond):
         """The paged twin of ``_decode_impl``: identical fused K-step
         emit-ring program, but K/V reads go through the block tables —
         the dense-view gather, or the in-place Pallas ragged
@@ -466,17 +659,10 @@ class Engine:
         per-chunk constant — the host maps every page the chunk could
         write before dispatch — so this too traces exactly once."""
         self.decode_traces += 1
-        from dalle_pytorch_tpu.models import dalle as D
         from dalle_pytorch_tpu.ops import decode as decode_ops
 
-        def embed_fn(tok, p):
-            return D.decode_token_embed(params, self.cfg, tok, p)
-
-        def sample_fn(h, pred_pos):
-            logits = self._logits_sync(D.to_logits(params, h))
-            return D.sample_per_slot(logits, pred_pos, keys, temp,
-                                     topk_k, top_p, self.cfg)
-
+        embed_fn, sample_fn = self._cfg_closures(
+            params, keys, temp, topk_k, top_p, partner, cfgs, uncond)
         return decode_ops.decode_loop_paged(
             params["transformer"], cur_tok, pos, active, cache,
             block_tables, cfg=self.cfg.transformer,
@@ -504,7 +690,8 @@ class Engine:
 
         def pre(params, cache, cur_tok, pos, active, rng, temp, topk_k,
                 top_p, text, lens, slots, n_seed, n_temp,
-                n_topk, n_top_p, page_rows=None):
+                n_topk, n_top_p, n_partner, n_cfgs, n_uncond,
+                page_rows=None):
             # page_rows rides only the paged trace: dense admission
             # omits it entirely (no dead argument, no wasted transfer)
             self.prefill_traces += 1
@@ -552,8 +739,17 @@ class Engine:
             h_last = jnp.take_along_axis(
                 h, (lens - 1)[:, None, None], axis=1)[:, 0]
             logits = self._logits_sync(D.to_logits(params, h_last))
+            # n_partner is the GROUP-row index of a guided row's pair
+            # (both members admit in the same bucket group: the null
+            # caption has the cond prompt's length); the same
+            # mix/copy as the fused decode step covers the FIRST
+            # sampled token — at position t0 == text_seq_len that
+            # token is already an image position and must be guided
             first = D.sample_per_slot(logits, lens, n_rng, n_temp,
-                                      n_topk, n_top_p, self.cfg)
+                                      n_topk, n_top_p, self.cfg,
+                                      partner=n_partner,
+                                      cfg_scale=n_cfgs,
+                                      uncond=n_uncond)
             cur_tok = cur_tok.at[slots].set(first, mode="drop")
             pos = pos.at[slots].set(lens, mode="drop")
             active = active.at[slots].set(True, mode="drop")
@@ -561,11 +757,52 @@ class Engine:
             temp = temp.at[slots].set(n_temp, mode="drop")
             topk_k = topk_k.at[slots].set(n_topk, mode="drop")
             top_p = top_p.at[slots].set(n_top_p, mode="drop")
-            return cache, cur_tok, pos, active, rng, temp, topk_k, top_p
+            # h_last rides back out for the prefix cache's insert (the
+            # warm path's first token samples from exactly this row)
+            return (cache, cur_tok, pos, active, rng, temp, topk_k,
+                    top_p, h_last)
 
         fn = self._jit_prefill_program(pre)
         self._prefill_fns[bucket] = fn
         return fn
+
+    def _warm_admit_fn(self):
+        """Admission program for prefix-cache WARM hits: the prompt's KV
+        already sits in shared pages and its last hidden row is cached,
+        so admission is ONE ``to_logits`` + per-slot first-token sample
+        + the device-side state merge — zero transformer FLOPs, and no
+        bucket dependence (h_last is (G, dim) whatever the prompt
+        length), so it compiles exactly once for the engine's life.
+        Byte-identity with the cold path holds because prefill rows are
+        batch-row-independent: the cached h_last IS the row the cold
+        program would have computed, and the sample math is the same
+        ``sample_per_slot`` call."""
+        if self._warm_fn is not None:
+            return self._warm_fn
+        import jax
+
+        def warm(params, cur_tok, pos, active, rng, temp, topk_k, top_p,
+                 h_last, lens, slots, n_seed, n_temp, n_topk, n_top_p,
+                 n_partner, n_cfgs, n_uncond):
+            self.warm_admit_traces += 1
+            from dalle_pytorch_tpu.models import dalle as D
+            n_rng = jax.vmap(jax.random.PRNGKey)(n_seed)
+            logits = self._logits_sync(D.to_logits(params, h_last))
+            first = D.sample_per_slot(logits, lens, n_rng, n_temp,
+                                      n_topk, n_top_p, self.cfg,
+                                      partner=n_partner,
+                                      cfg_scale=n_cfgs, uncond=n_uncond)
+            cur_tok = cur_tok.at[slots].set(first, mode="drop")
+            pos = pos.at[slots].set(lens, mode="drop")
+            active = active.at[slots].set(True, mode="drop")
+            rng = rng.at[slots].set(n_rng, mode="drop")
+            temp = temp.at[slots].set(n_temp, mode="drop")
+            topk_k = topk_k.at[slots].set(n_topk, mode="drop")
+            top_p = top_p.at[slots].set(n_top_p, mode="drop")
+            return cur_tok, pos, active, rng, temp, topk_k, top_p
+
+        self._warm_fn = self._jit_warm_program(warm)
+        return self._warm_fn
 
     # -- request lifecycle --------------------------------------------------
 
@@ -606,7 +843,8 @@ class Engine:
         aggregate keeps counting distinct delivered tokens even though
         parent and child never share memory)."""
         return {s.handle.request.request_id: len(s.emitted)
-                for s in list(self.slots) if s is not None}
+                for s in list(self.slots)
+                if s is not None and s.shadow_of is None}
 
     def counters(self) -> Dict[str, int]:
         """The ``COUNTERS`` block as a dict (heartbeat/retire surface)."""
@@ -624,6 +862,29 @@ class Engine:
         if self.decode_traces == 0 and (self.active_slots() > 0
                                         or self.queue.depth() > 0):
             return True
+        if self.prefix is not None and self.warm_admit_traces == 0 \
+                and self.queue.depth() > 0:
+            # only when a queued prompt would ACTUALLY admit warm (its
+            # key is indexed, or a same-key sibling is queued ahead of
+            # it — the warm-after fan-out) does the next step risk the
+            # warm program's one-time compile. A blanket True here
+            # would stretch a process worker's hang deadline from
+            # heartbeat_s to compile_grace_s for the engine's whole
+            # life under unique-prompt traffic.
+            from dalle_pytorch_tpu.serve import prefix_cache as PC
+            seen: set = set()
+            for codes, cfg_scale in self.queue.pending_prompt_codes():
+                rows = [tuple(int(c) for c in codes)]
+                if cfg_scale > 0:
+                    rows.append((0,) * len(codes))
+                for row in rows:
+                    key = PC.prefix_key(
+                        row, model_version=self.model_version,
+                        layer_sig=self._layer_sig,
+                        quantized=self.quantize_cache)
+                    if key in self.prefix or key in seen:
+                        return True
+                    seen.add(key)
         for n in self.queue.pending_prompt_lens():
             try:
                 b = S.bucket_for(n, self.buckets)
@@ -688,6 +949,79 @@ class Engine:
             queued_s=round(now - req.submit_t, 6),
             total_s=round(now - req.submit_t, 6)))
 
+    def _cfg_wire(self, i: int, j: int, scale: float) -> None:
+        """Host-side pairing of cond slot i with uncond shadow j."""
+        self._cfg_partner_host[i] = j
+        self._cfg_partner_host[j] = i
+        self._cfg_scale_host[i] = np.float32(scale)
+        self._cfg_scale_host[j] = np.float32(scale)
+        self._cfg_uncond_host[i] = False
+        self._cfg_uncond_host[j] = True
+        self._cfg_dirty = True
+
+    def _cfg_reset(self, i: int) -> None:
+        """Back to unpaired: partner = self, scale 0 (every guidance op
+        in the fused program is then an exact identity for slot i)."""
+        self._cfg_partner_host[i] = i
+        self._cfg_scale_host[i] = 0.0
+        self._cfg_uncond_host[i] = False
+        self._cfg_dirty = True
+
+    def _sync_cfg(self) -> None:
+        """Push the host-authoritative guidance-pair state — same
+        explicit-device_put discipline as the block tables."""
+        if self._cfg_dirty:
+            (self.cfg_partner, self.cfg_scale,
+             self.cfg_uncond) = (
+                self._put(self._cfg_partner_host),
+                self._put(self._cfg_scale_host),
+                self._put(self._cfg_uncond_host))
+            self._cfg_dirty = False
+
+    def _plan_rows(self, take: List[S.RequestHandle]
+                   ) -> Dict[int, List[_Row]]:
+        """Expand handles into per-slot admission rows: one for a plain
+        request, a cond/uncond pair for a guided one (the uncond row
+        runs the all-PAD null caption of the SAME length, so the pair
+        always lands in one prefill bucket)."""
+        per_handle: Dict[int, List[_Row]] = {}
+        for h in take:
+            r = h.request
+            rc = _Row(h, tuple(int(c) for c in r.codes), uncond=False)
+            hrows = [rc]
+            if r.cfg_scale > 0:
+                ru = _Row(h, (0,) * len(r.codes), uncond=True)
+                rc.pair_row = ru
+                ru.pair_row = rc
+                hrows.append(ru)
+            for p in hrows:
+                p.bucket = S.bucket_for(p.t0, self.buckets)
+            per_handle[r.request_id] = hrows
+        return per_handle
+
+    def _classify_row(self, p: _Row, pending: set) -> None:
+        """Prefix-cache disposition of one row (paged mode). The lookup
+        verifies the stored token tuple, so a hash collision reads as a
+        miss, never as another prompt's KV."""
+        from dalle_pytorch_tpu.serve import kv_pool as KV
+        from dalle_pytorch_tpu.serve import prefix_cache as PC
+        p.total_pages = KV.pages_for(p.bucket, self.page_size)
+        if self.prefix is None:
+            return
+        p.key = PC.prefix_key(p.codes, model_version=self.model_version,
+                              layer_sig=self._layer_sig,
+                              quantized=self.quantize_cache)
+        p.entry = self.prefix.lookup(p.key, p.codes)
+        if p.entry is not None:
+            p.mode = "warm"
+            p.shared_n = len(p.entry.full_pages)
+        elif p.key in pending:
+            # an earlier cold row of THIS admission is prefilling the
+            # same prompt (the N-samples fan-out): admit warm after
+            # its insert lands — the shared span is allocated once
+            p.mode = "warm_pending"
+            p.shared_n = p.t0 // self.page_size
+
     def _admit(self, handles: List[S.RequestHandle], now: float) -> None:
         if self.fenced:
             # fenced mid-step after the pop: these handles are in
@@ -697,7 +1031,6 @@ class Engine:
             self._orphan_handles(handles)
             return
         free = [i for i, s in enumerate(self.slots) if s is None]
-        assert len(handles) <= len(free)
         valid = []
         for h in handles:
             # the server's queue validates at submit; a raw queue may
@@ -708,9 +1041,28 @@ class Engine:
                 self._error(h, now, f"invalid prompt length {n} "
                             f"(need 1..{self.cfg.text_seq_len})")
                 continue
+            if h.request.cfg_scale > 0 and self.num_slots < 2:
+                self._error(h, now, "cfg_scale needs a cond/uncond "
+                            "slot pair: num_slots must be >= 2")
+                continue
             valid.append(h)
-        grants: dict = {}
-        if self.kv == "paged" and valid:
+        # slot budget in arrival order: a guided request takes TWO
+        # slots, so the pop (one handle per free slot) can overshoot —
+        # the overflow re-enters at its original position, never drops
+        budget = len(free)
+        take: List[S.RequestHandle] = []
+        for k, h in enumerate(valid):
+            width = 2 if h.request.cfg_scale > 0 else 1
+            if width > budget:
+                for hh in valid[k:]:
+                    self._requeue_or_orphan(hh)
+                break
+            budget -= width
+            take.append(h)
+        per_handle = self._plan_rows(take)
+
+        rows: List[_Row] = []
+        if self.kv == "paged" and take:
             # admission is gated on FREE PAGES, not just free slots: the
             # prompt span (rows [0, bucket), which prefill writes) must
             # be mapped up front. The fit check runs in ARRIVAL order
@@ -723,18 +1075,37 @@ class Engine:
             # order, later/smaller requests can never consume the pages
             # freed for it. A full sequence always fits the pool alone
             # (constructor invariant), so the head always eventually
-            # fits and no request starves.
-            from dalle_pytorch_tpu.serve import kv_pool as KV
+            # fits and no request starves. Need is PREFIX-AWARE: a warm
+            # row pays only its private span, and the LRU end of the
+            # prefix index is dropped before a request is deferred.
             fits: List[S.RequestHandle] = []
-            for k, h in enumerate(valid):
+            pending: set = set()
+            for k, h in enumerate(take):
                 rid = h.request.request_id
-                need = KV.pages_for(S.bucket_for(len(h.request.codes),
-                                                 self.buckets),
-                                    self.page_size)
+                hrows = per_handle[rid]
+                for p in hrows:
+                    self._classify_row(p, pending)
+                if len(hrows) == 2:
+                    # a MIXED pair (one side warm, one cold) admits
+                    # whole-cold: the pair's first token mixes both
+                    # streams' logits inside ONE program, and that
+                    # program is the bucket prefill
+                    modes = {p.mode for p in hrows}
+                    if "cold" in modes and modes != {"cold"}:
+                        for p in hrows:
+                            p.mode, p.shared_n, p.entry = "cold", 0, None
+                for p in hrows:
+                    if p.mode == "cold" and p.key is not None:
+                        pending.add(p.key)
+                need = sum(p.total_pages - p.shared_n for p in hrows)
+                if self.alloc.free < need and self.prefix is not None:
+                    # cached prefixes are a perf lever, live requests
+                    # are work: drop LRU entries before deferring
+                    self.prefix.shrink(need)
                 if self.alloc.free < need:
                     # head-of-line block: requeue this and every later
                     # pop (arrival order preserved by queue_seq)
-                    for hh in valid[k:]:
+                    for hh in take[k:]:
                         self._requeue_or_orphan(hh)
                     self._hol_rid = rid
                     self._hol_need = need
@@ -752,19 +1123,37 @@ class Engine:
                                 pages_needed=need,
                                 pages_free=self.alloc.free))
                     break
+                for p in hrows:
+                    p.grants = self.alloc.alloc(
+                        p.total_pages - p.shared_n)
                 fits.append(h)
+                rows.extend(hrows)
                 self._deferred_ids.discard(rid)
                 if rid == self._hol_rid:
                     self._hol_rid = None
                     self._hol_need = 0
-                grants[rid] = self.alloc.alloc(need)
-            valid = fits
-        for bucket, group in S.group_by_bucket(valid, self.buckets).items():
+            take = fits
+        else:
+            for h in take:
+                rows.extend(per_handle[h.request.request_id])
+
+        free = self._admit_cold(rows, free, now)
+        self._admit_warm(rows, free, now)
+
+    def _admit_cold(self, rows: List[_Row], free: List[int],
+                    now: float) -> List[int]:
+        """Bucket-grouped prefill admission of the plan's cold rows.
+        Returns the free-slot indices left for the warm phase."""
+        groups: Dict[int, List[_Row]] = {}
+        for p in rows:
+            if p.mode == "cold":
+                groups.setdefault(p.bucket, []).append(p)
+        for bucket, group in groups.items():
             if self.fenced:
                 # fenced between groups: the rest of the admission is
                 # step locals the reclaim sweep cannot see — orphan
                 # them back to the shared queue
-                self._orphan_handles(group)
+                self._orphan_handles(self._unique_handles(group))
                 continue
             idx = free[:len(group)]
             free = free[len(group):]
@@ -783,27 +1172,24 @@ class Engine:
             n_temp = np.ones((G,), np.float32)
             n_topk = np.ones((G,), np.int32)
             n_top_p = np.zeros((G,), np.float32)
-            v = self.cfg.total_tokens
-            for j, h in enumerate(group):
-                req = h.request
-                text[j, :len(req.codes)] = req.codes
-                lens[j] = len(req.codes)
+            n_partner = np.arange(G, dtype=np.int32)
+            n_cfgs = np.zeros((G,), np.float32)
+            n_uncond = np.zeros((G,), bool)
+            for j, p in enumerate(group):
+                p.slot, p.group_idx = idx[j], j
+                self._fill_admit_row(p, j, lens, n_seed, n_temp, n_topk,
+                                     n_top_p, n_cfgs, n_uncond)
+                text[j, :p.t0] = p.codes
                 slots[j] = idx[j]
                 if self.kv == "paged":
-                    pages = grants[req.request_id]
                     self._bt_host[idx[j], :] = 0
-                    self._bt_host[idx[j], :len(pages)] = pages
+                    self._bt_host[idx[j], :len(p.grants)] = p.grants
                     page_rows[j] = self._bt_host[
                         idx[j], np.arange(bucket) // self.page_size]
-                # two's-complement truncation to int32: PRNGKey keeps
-                # only the low 32 bits under the default x64-off mode,
-                # so this is value-identical to PRNGKey(seed) eager
-                s = int(req.seed) & 0xFFFFFFFF
-                n_seed[j] = s - (1 << 32) if s >= (1 << 31) else s
-                n_temp[j] = np.float32(req.sampling.temperature)
-                n_topk[j] = max(
-                    int((1 - req.sampling.filter_thres) * v), 1)
-                n_top_p[j] = np.float32(req.sampling.top_p)
+            for j, p in enumerate(group):
+                # a pair's rows always share the bucket, hence the group
+                if p.pair_row is not None and p.pair_row in group:
+                    n_partner[j] = p.pair_row.group_idx
             try:
                 # explicit-transfer discipline: the admission path's
                 # host->device traffic is device_put at the site, never
@@ -816,14 +1202,21 @@ class Engine:
                 if cold:
                     self.compiling = True
                 try:
+                    t_pre = self.clock()
                     outs = self._prefill_fn(bucket)(
                         self.params, self.cache, self.cur_tok, self.pos,
                         self.active, self.rng, self.temp, self.topk_k,
                         self.top_p, put(text), put(lens), put(slots),
                         put(n_seed), put(n_temp), put(n_topk),
-                        put(n_top_p),
+                        put(n_top_p), put(n_partner), put(n_cfgs),
+                        put(n_uncond),
                         **({"page_rows": put(page_rows)}
                            if self.kv == "paged" else {}))
+                    self.prefill_runs += 1
+                    if self.time_admissions and not cold:
+                        import jax
+                        jax.block_until_ready(outs[1])
+                        self.prefill_times.append(self.clock() - t_pre)
                 finally:
                     if cold:
                         self.compiling = False
@@ -834,12 +1227,12 @@ class Engine:
                 # the pool stays consistent; the group's callers get a
                 # typed error instead of hanging on a dead loop
                 if self.kv == "paged":
-                    for j, h in enumerate(group):
-                        self.alloc.release(
-                            grants.pop(h.request.request_id))
+                    for j, p in enumerate(group):
+                        self.alloc.release(p.grants)
+                        p.grants = []
                         self._bt_host[idx[j], :] = 0
                     self._bt_dirty = True
-                for h in group:
+                for h in self._unique_handles(group):
                     self._error(h, now, f"prefill failed: {e!r}")
                 continue
             if self.fenced:
@@ -849,25 +1242,241 @@ class Engine:
                 # this group (neither queued nor slotted, just step
                 # locals), so hand it back to the shared queue instead
                 # of slotting it into a dead engine
-                self._orphan_handles(group)
+                self._orphan_handles(self._unique_handles(group))
                 continue
             (self.cache, self.cur_tok, self.pos, self.active, self.rng,
-             self.temp, self.topk_k, self.top_p) = outs
-            for i, h in zip(idx, group):
-                self.slots[i] = _Slot(h, len(h.request.codes), now)
+             self.temp, self.topk_k, self.top_p, h_last) = outs
+            for p in group:
+                i = p.slot
+                self.slots[i] = _Slot(p.handle, p.t0, now)
                 if self.kv == "paged":
-                    self._slot_pages[i] = grants.pop(h.request.request_id)
-                    self._pos_est[i] = len(h.request.codes)
+                    self._slot_pages[i] = list(p.grants)
+                    self._pos_est[i] = p.t0
                     self._bt_dirty = True
+            self._wire_pairs(group)
+            if self.prefix is not None:
+                for p in group:
+                    self._prefix_insert(p, h_last)
+        return free
+
+    def _fill_admit_row(self, p: _Row, j: int, lens, n_seed, n_temp,
+                        n_topk, n_top_p, n_cfgs, n_uncond) -> None:
+        """One admission row's sampling knobs (shared by the cold and
+        warm programs; an uncond shadow carries its cond request's
+        knobs — its own draw is overwritten by the partner copy)."""
+        req = p.handle.request
+        lens[j] = p.t0
+        # two's-complement truncation to int32: PRNGKey keeps
+        # only the low 32 bits under the default x64-off mode,
+        # so this is value-identical to PRNGKey(seed) eager
+        s = int(req.seed) & 0xFFFFFFFF
+        n_seed[j] = s - (1 << 32) if s >= (1 << 31) else s
+        n_temp[j] = np.float32(req.sampling.temperature)
+        n_topk[j] = max(
+            int((1 - req.sampling.filter_thres) * self.cfg.total_tokens),
+            1)
+        n_top_p[j] = np.float32(req.sampling.top_p)
+        n_cfgs[j] = np.float32(req.cfg_scale)
+        n_uncond[j] = p.uncond
+
+    def _unique_handles(self, group: List[_Row]) -> List[S.RequestHandle]:
+        out, seen = [], set()
+        for p in group:
+            rid = p.handle.request.request_id
+            if rid not in seen:
+                seen.add(rid)
+                out.append(p.handle)
+        return out
+
+    def _wire_pairs(self, group: List[_Row]) -> None:
+        """Link freshly slotted cond/uncond pairs (host bookkeeping +
+        the device-side partner/scale/uncond state)."""
+        for p in group:
+            if p.pair_row is None or p.uncond:
+                continue
+            i, j = p.slot, p.pair_row.slot
+            self.slots[i].pair = j
+            self.slots[j].shadow_of = i
+            self._cfg_wire(i, j, p.handle.request.cfg_scale)
+            self.cfg_pairs += 1
+
+    def _prefix_insert(self, p: _Row, h_last) -> None:
+        """Index a cold row's freshly prefilled prompt span: the full
+        prompt pages (retained by the index), a COW snapshot of the
+        partial boundary page, and the last hidden row. Taken NOW —
+        before any decode chunk can write rows >= t0 into the boundary
+        page — so the cached copy is immutable from here on."""
+        if p.key is None or p.key in self.prefix:
+            return
+        from dalle_pytorch_tpu.serve import prefix_cache as PC
+        i = p.slot
+        s_full = p.t0 // self.page_size
+        snap = None
+        if p.t0 % self.page_size:
+            pid = self._slot_pages[i][s_full]
+            snap = self._snap_fn(self.cache, self._put(np.int32(pid)))
+        self.prefix.insert(PC.PrefixEntry(
+            p.key, p.codes, p.t0, self._slot_pages[i][:s_full], snap,
+            h_last[p.group_idx]))
+
+    def _admit_warm(self, rows: List[_Row], free: List[int],
+                    now: float) -> None:
+        """Zero-prefill admission of the plan's warm rows: map shared
+        pages (refcount++), fork boundary pages copy-on-write, and run
+        the ONE warm-admission program for first tokens + state merge."""
+        warm: List[_Row] = []
+        for p in rows:
+            if p.mode not in ("warm", "warm_pending"):
+                continue
+            if p.pair_row is not None and p.uncond:
+                continue            # handled with its cond row below
+            hrows = [p] + ([p.pair_row] if p.pair_row is not None else [])
+            resolved = True
+            for q in hrows:
+                if q.entry is None:
+                    q.entry = self.prefix.lookup(q.key, q.codes)
+                if q.entry is None \
+                        or len(q.entry.full_pages) != q.shared_n:
+                    # the cold sibling whose insert this warm-after
+                    # rode never landed (its prefill failed): give the
+                    # pages back and retry cold next pop
+                    resolved = False
+            if not resolved or self.fenced:
+                for q in hrows:
+                    if q.grants:
+                        self.alloc.release(q.grants)
+                        q.grants = []
+                self._requeue_or_orphan(p.handle)
+                continue
+            warm.extend(hrows)
+        if not warm:
+            return
+        import jax
+        import jax.numpy as jnp
+        G = self.num_slots
+        lens = np.ones((G,), np.int32)
+        slots = np.full((G,), self.num_slots, np.int32)
+        n_seed = np.zeros((G,), np.int32)
+        n_temp = np.ones((G,), np.float32)
+        n_topk = np.ones((G,), np.int32)
+        n_top_p = np.zeros((G,), np.float32)
+        n_partner = np.arange(G, dtype=np.int32)
+        n_cfgs = np.zeros((G,), np.float32)
+        n_uncond = np.zeros((G,), bool)
+        h_rows = []
+        mapped: List[_Row] = []
+        coldw = self.warm_admit_traces == 0
+        if coldw:
+            self.compiling = True
+        try:
+            try:
+                for j, p in enumerate(warm):
+                    i = free[j]
+                    p.slot, p.group_idx = i, j
+                    entry = p.entry
+                    # the tentpole's read-only-sharing proof, asserted
+                    # at every warm mapping: shared pages all lie wholly
+                    # below t0, and decode only ever appends at
+                    # positions >= t0 — so _store_rows_paged can never
+                    # scatter into a shared page
+                    assert p.t0 >= p.shared_n * self.page_size, \
+                        "shared prefix pages must end at/below the " \
+                        "prompt length"
+                    self.alloc.retain(entry.full_pages)
+                    mapped.append(p)
+                    pages = list(entry.full_pages) + list(p.grants)
+                    self._bt_host[i, :] = 0
+                    self._bt_host[i, :len(pages)] = pages
+                    if entry.boundary_snap is not None:
+                        # COW fork: the consumer's private boundary page
+                        # starts as a byte copy of the cached one, then
+                        # diverges under its own decode writes
+                        self.cache = self._restore_fn(
+                            self.cache, self._put(np.int32(p.grants[0])),
+                            entry.boundary_snap)
+                    self._fill_admit_row(p, j, lens, n_seed, n_temp,
+                                         n_topk, n_top_p, n_cfgs,
+                                         n_uncond)
+                    slots[j] = i
+                    h_rows.append(entry.h_last)
+                for j, p in enumerate(warm):
+                    if p.pair_row is not None and p.pair_row in warm:
+                        n_partner[j] = p.pair_row.group_idx
+                if len(h_rows) < G:
+                    # pad with a live row, not zeros_like (whose fill
+                    # scalar would be an implicit host->device
+                    # transfer): pad rows scatter to the dropped
+                    # out-of-range slot index, so their values never
+                    # land anywhere
+                    h_rows = h_rows + [h_rows[0]] * (G - len(h_rows))
+                h_stack = jnp.stack(h_rows)
+                put = self._put
+                t_warm = self.clock()
+                outs = self._warm_admit_fn()(
+                    self.params, self.cur_tok, self.pos, self.active,
+                    self.rng, self.temp, self.topk_k, self.top_p,
+                    h_stack, put(lens), put(slots), put(n_seed),
+                    put(n_temp), put(n_topk), put(n_top_p),
+                    put(n_partner), put(n_cfgs), put(n_uncond))
+                if self.time_admissions and not coldw:
+                    jax.block_until_ready(outs[0])
+                    self.warm_admit_times.append(self.clock() - t_warm)
+            finally:
+                if coldw:
+                    self.compiling = False
+                    self.last_heartbeat = self.clock()
+        except Exception as e:  # noqa: BLE001 — no-hangs contract
+            # nothing was slotted: give back every reference the
+            # mapping loop took (shared retains + private grants) and
+            # the un-mapped rows' grants, then fail the callers typed
+            for p in warm:
+                if p in mapped:
+                    self.alloc.release(list(p.entry.full_pages)
+                                       + list(p.grants))
+                    self._bt_host[p.slot, :] = 0
+                elif p.grants:
+                    self.alloc.release(p.grants)
+                p.grants = []
+            self._bt_dirty = True
+            for h in self._unique_handles(warm):
+                self._error(h, now, f"warm admission failed: {e!r}")
+            return
+        if self.fenced:
+            # same contract as the prefill-call fence: not slotted, so
+            # the reclaim sweep cannot see these — orphan them
+            self._orphan_handles(self._unique_handles(warm))
+            return
+        (self.cur_tok, self.pos, self.active, self.rng, self.temp,
+         self.topk_k, self.top_p) = outs
+        for p in warm:
+            i = p.slot
+            self.slots[i] = _Slot(p.handle, p.t0, now)
+            self._slot_pages[i] = list(p.entry.full_pages) + \
+                list(p.grants)
+            self._pos_est[i] = p.t0
+            self._bt_dirty = True
+            self.prefix_hits += 1
+            self.warm_admits += 1
+            if self.metrics is not None:
+                self.metrics.event(**S.structured_event(
+                    "serve_prefix_hit",
+                    request_id=p.handle.request.request_id,
+                    uncond=p.uncond, pages_shared=p.shared_n,
+                    pages_private=len(p.grants)))
+        self._wire_pairs(warm)
 
     # -- page-pool lifecycle (kv='paged') -----------------------------------
 
     def _release_slot_pages(self, i: int) -> None:
-        """Free slot i's pages back to the pool and zero its block-table
-        row (completion/expiry/eviction/terminate). The stale device-side
-        row needs no urgent push: the dead slot's writes are redirected
-        to the trash page inside the program (active=False), and reads of
-        re-assigned pages are causally masked."""
+        """Drop slot i's page REFERENCES back to the pool and zero its
+        block-table row (completion/expiry/eviction/terminate). Under
+        prefix sharing a reference drop is not necessarily a free: a
+        shared prompt page stays resident while the index (or a sibling
+        slot) still maps it — the refcounted allocator frees only at
+        zero. The stale device-side row needs no urgent push: the dead
+        slot's writes are redirected to the trash page inside the
+        program (active=False), and reads of re-assigned pages are
+        causally masked."""
         if self._slot_pages[i]:
             self.alloc.release(self._slot_pages[i])
             self._slot_pages[i] = []
@@ -875,36 +1484,58 @@ class Engine:
         self._pos_est[i] = 0
         self._bt_dirty = True
 
-    def _free_slot(self, i: int) -> None:
+    def _free_slot(self, i: int) -> List[int]:
         """The one slot-teardown path (completion/expiry/eviction/
-        terminate): vacate the slot and, in paged mode, return its pages
-        to the pool — forgetting the paged branch would leak pages until
-        the pool wedged, so no call site spells it out by hand."""
+        terminate): vacate the slot and, in paged mode, return its page
+        references to the pool — forgetting the paged branch would leak
+        pages until the pool wedged, so no call site spells it out by
+        hand. A guided pair tears down ATOMICALLY: freeing the cond
+        slot frees its uncond shadow too (the shadow is never freed on
+        its own — it has no life of its own to end). Returns the freed
+        slot indices, so callers that must clear device active bits
+        (expiry, eviction) kill every member."""
+        slot = self.slots[i]
+        freed = [i]
         self.slots[i] = None
         if self.kv == "paged":
             self._release_slot_pages(i)
+        self._cfg_reset(i)
+        j = slot.pair if slot is not None else None
+        if j is not None and self.slots[j] is not None \
+                and self.slots[j].shadow_of == i:
+            self.slots[j] = None
+            if self.kv == "paged":
+                self._release_slot_pages(j)
+            self._cfg_reset(j)
+            freed.append(j)
+        return freed
 
     def _evict_lowest_priority(self, now: float) -> bool:
         """The PagePoolExhausted backpressure path: evict the LOWEST-
         priority active request (highest priority value; ties broken by
-        latest admission) back to the queue. Its pages are freed, its
-        device slot killed, and its handle re-queued intact — on
+        latest admission) back to the queue. Its page references are
+        dropped — under sharing, a page a sibling (or the prefix index)
+        still maps stays OUT of the free list: the refcounted release
+        is what makes eviction safe next to copy-on-write sharing — its
+        device slot(s) killed, and its handle re-queued intact; on
         re-admission, deterministic sampling (same seed, same fold_in
         positions) replays its exact token stream, so eviction costs
-        latency, never correctness. Returns False when no slot is
-        active."""
+        latency, never correctness. A guided pair evicts whole. Returns
+        False when no slot is active."""
         if self.fenced:
             return False    # the reclaim sweep owns every in-slot handle
         cand = [(s.handle.request.priority, s.t_admit, i)
-                for i, s in enumerate(self.slots) if s is not None]
+                for i, s in enumerate(self.slots)
+                if s is not None and s.shadow_of is None]
         if not cand:
             return False
         _, _, i = max(cand)
         slot = self.slots[i]
-        freed = len(self._slot_pages[i])
-        self._free_slot(i)
+        free_before = self.alloc.free
+        killed = self._free_slot(i)
+        freed = self.alloc.free - free_before
         keep = np.ones((self.num_slots,), bool)
-        keep[i] = False
+        keep[killed] = False
         self.active = self._kill_fn(self.active, self._put(keep))
         self.evicted += 1
         # un-credit the victim's harvested tokens: re-admission replays
@@ -942,6 +1573,11 @@ class Engine:
                     - len(self._slot_pages[i])
                 if short <= 0:
                     break
+                if self.alloc.free < short and self.prefix is not None:
+                    # drop cached prefixes (LRU first) before evicting
+                    # live work — an index-held page a live slot no
+                    # longer shares frees immediately at release
+                    self.prefix.shrink(short)
                 if self.alloc.free >= short:
                     for p in self.alloc.alloc(short):
                         self._bt_host[i, len(self._slot_pages[i])] = p
@@ -974,6 +1610,7 @@ class Engine:
         if cold:
             self.compiling = True
         try:
+            self._sync_cfg()
             if self.kv == "paged":
                 self._map_ahead(now)
                 self._sync_block_tables()
@@ -981,12 +1618,16 @@ class Engine:
                 outs = self._decode_fn(self.params, self.cache,
                                        self.block_tables, self.cur_tok,
                                        self.pos, self.active, self.rng,
-                                       self.temp, self.topk_k, self.top_p)
+                                       self.temp, self.topk_k, self.top_p,
+                                       self.cfg_partner, self.cfg_scale,
+                                       self.cfg_uncond)
             else:
                 outs = self._decode_fn(self.params, self.cache,
                                        self.cur_tok, self.pos,
                                        self.active, self.rng, self.temp,
-                                       self.topk_k, self.top_p)
+                                       self.topk_k, self.top_p,
+                                       self.cfg_partner, self.cfg_scale,
+                                       self.cfg_uncond)
         finally:
             if cold:
                 self.compiling = False
@@ -1021,6 +1662,12 @@ class Engine:
         self.last_heartbeat = now
         emitted = 0
         for i, slot in rec.owners:
+            if slot.shadow_of is not None:
+                # uncond shadow of a guided pair: its ring row mirrors
+                # the cond stream (partner copy) — crediting it would
+                # double-count delivered tokens, and it completes with
+                # its cond slot, never on its own
+                continue
             if slot.handle.done() or self.slots[i] is not slot:
                 # expired/killed/errored/EVICTED since dispatch — its
                 # ring row is dead (an evicted request replays every
@@ -1091,13 +1738,12 @@ class Engine:
             # in-flight chunk's leftover tokens die with the owner check)
             kill = []
             for i, slot in enumerate(self.slots):
-                if slot is None:
-                    continue
+                if slot is None or slot.shadow_of is not None:
+                    continue        # a shadow expires with its cond slot
                 dt = slot.handle.request.deadline_t
                 if dt is not None and now > dt:
                     self._expire(slot.handle, now, where="decoding")
-                    self._free_slot(i)
-                    kill.append(i)
+                    kill.extend(self._free_slot(i))
             if kill:
                 keep = np.ones((self.num_slots,), bool)
                 keep[kill] = False
@@ -1113,6 +1759,12 @@ class Engine:
                 # floor is the smallest bucket's prompt span
                 floor = self._hol_need if self._hol_rid is not None \
                     else self._min_admit_pages
+                if self.alloc.free < floor and self.prefix is not None \
+                        and self.queue.depth() > 0:
+                    # an idle pool held hostage by cached prefixes
+                    # would gate admission forever: shrink the LRU end
+                    # until the floor could pop
+                    self.prefix.shrink(floor)
                 if self.alloc.free < floor:
                     free = 0
             ready, expired = self.queue.pop_ready(free, now)
@@ -1213,8 +1865,8 @@ class Engine:
         with self._lock:
             now = self.clock()
             for i, slot in enumerate(self.slots):
-                if slot is None:
-                    continue
+                if slot is None or slot.shadow_of is not None:
+                    continue        # a shadow dies with its cond slot
                 req = slot.handle.request
                 slot.handle.fulfill(S.Result(
                     status=status, request_id=req.request_id,
@@ -1227,6 +1879,7 @@ class Engine:
             self.cur_tok = jnp.zeros((self.num_slots,), jnp.int32)
             self.pos = jnp.zeros((self.num_slots,), jnp.int32)
             self.active = jnp.zeros((self.num_slots,), bool)
+            self._sync_cfg()
             if self.kv == "paged":
                 self._sync_block_tables()
         return n
@@ -1321,14 +1974,35 @@ class Engine:
                         sparse_reads=False),
                 "page_size": self.page_size,
                 "num_pages": self.num_pages,
+                # PHYSICAL pages: the refcounted allocator counts a
+                # page shared by N block tables (or held by the prefix
+                # index) exactly once, which is what keeps this gauge —
+                # and kv_hbm_bytes, the pool's resident bytes — exact
+                # under sharing
                 "pages_in_use": self.alloc.in_use,
                 "pages_free": self.alloc.free,
                 "pages_peak": self.alloc.peak_in_use,
                 "pages_in_use_p95": self.pages_in_use_p95(),
+                "pages_shared": self.alloc.pages_shared,
+                "pages_shared_saved": self.alloc.refs_saved,
                 "evicted": self.evicted,
                 "deferred": self.deferred,
                 "requeued": self.queue.requeued,
             }
+            if self.prefix is not None:
+                paged.update({
+                    "prefix_cache": True,
+                    "prefix_hits": self.prefix_hits,
+                    "prefix_entries": len(self.prefix),
+                    "prefix_pages_held": self.prefix.pages_held,
+                    "prefix_evictions": self.prefix.evicted,
+                    "warm_admits": self.warm_admits,
+                    "prefill_runs": self.prefill_runs,
+                })
+                if self.time_admissions:
+                    paged["prefill_p50_ms"] = _p50_ms(self.prefill_times)
+                    paged["warm_admit_p50_ms"] = _p50_ms(
+                        self.warm_admit_times)
         return {
             "kv": self.kv,
             "kv_hbm_bytes": self.kv_hbm_bytes(),
@@ -1346,6 +2020,7 @@ class Engine:
                                      / max(self.decode_steps, 1), 3)),
             "completed": self.completed,
             "expired": self.expired,
+            "cfg_pairs": self.cfg_pairs,
             "rejected": self.queue.rejected,
             "decode_compiles": self.decode_traces,
             "prefill_compiles": self.prefill_traces,

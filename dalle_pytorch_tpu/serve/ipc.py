@@ -196,7 +196,15 @@ def engine_snapshot(engine, chunks: int, rss_mb: int,
 def _snap_fields(payload: dict):
     """Validate + convert a snapshot payload; IPCError on wrong shapes."""
     try:
-        counters = {k: int(payload["counters"][k]) for k in COUNTERS}
+        raw = payload["counters"]
+        if not isinstance(raw, dict):
+            raise TypeError(f"counters must be a dict, got "
+                            f"{type(raw).__name__}")
+        # .get: a worker built before a COUNTERS key existed (version
+        # skew on a hand-started remote attach) reports 0 for it — the
+        # same decode-as-default tolerance Request.from_wire gives
+        # unknown request fields, instead of poisoning every heartbeat
+        counters = {k: int(raw.get(k, 0)) for k in COUNTERS}
         progress = {int(k): int(v)
                     for k, v in payload["progress"].items()}
         return (counters, progress, int(payload["active_slots"]),

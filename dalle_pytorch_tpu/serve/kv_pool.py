@@ -41,7 +41,7 @@ discipline): queue-side callers can type-check against
 
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List
 
 from dalle_pytorch_tpu.utils.metrics import structured_event
 
@@ -90,6 +90,24 @@ def validate_page_size(page_size: int) -> None:
             "serve_page_size_invalid", page_size=ps,
             min_page_size=KERNEL_MIN_PAGE_SIZE,
             page_multiple=KERNEL_PAGE_MULTIPLE))
+
+
+class PageReleaseUnderflow(ValueError):
+    """Typed refcount underflow: a release of a page whose refcount is
+    already zero (it is already on the free list). Under copy-on-write
+    sharing this is the same bug class the old double-release guard
+    caught — a page freed past its reference count would sit in the
+    free list while a sibling's block table still maps it, and the next
+    allocation would hand it to a SECOND live slot whose decode writes
+    would silently interleave with the sibling's reads. Fail at the
+    bug's site. ``record`` is the structured event."""
+
+    def __init__(self, record: dict):
+        super().__init__(
+            f"double release of page {record.get('page')}: its refcount "
+            f"is already 0 (it is already free) — freeing it again "
+            f"would let two live slots end up sharing it")
+        self.record = record
 
 
 class PagePoolExhausted(RuntimeError):
@@ -146,6 +164,27 @@ def visible_table_view(block_tables, visible):
     return jnp.take_along_axis(block_tables, visible, axis=1)
 
 
+def snapshot_page(pool: dict, page) -> dict:
+    """Device-side copy of ONE physical page across every layer (and the
+    int8 pool's scale pages): ``{k: (depth, heads, page_size[, dh])}``.
+    The prefix cache's copy-on-write source — taken at insert time,
+    BEFORE the inserting request's decode can write past its prompt
+    span into the same physical page. Traced (jax.numpy); the engine
+    jits it once per pool layout."""
+    return {k: pool[k][:, page] for k in pool}
+
+
+def restore_page(pool: dict, page, snap: dict) -> dict:
+    """Write a ``snapshot_page`` copy into physical page ``page`` — the
+    copy-on-write FORK: a warm-hit slot gets a private page whose
+    prompt-tail rows are byte-identical to the cached boundary page, so
+    its decode appends diverge without ever touching the shared copy.
+    Traced; the engine jits it once per pool layout (with the pool's
+    shardings pinned on a mesh engine, so the fork can never drift the
+    KV store's placement between fused chunks)."""
+    return {k: pool[k].at[:, page].set(snap[k]) for k in pool}
+
+
 def pool_bytes(pool: dict) -> int:
     """Resident HBM bytes of a pool (or of a dense cache dict) — the
     number ``bench_serve --serve_kv`` compares across layouts."""
@@ -179,9 +218,17 @@ def modeled_kv_bytes(cfg, *, kv: str, num_slots: int, total_len: int,
 
 class PageAllocator:
     """Host-side free-list over physical pages ``[1, num_pages)`` (page 0
-    is the reserved trash page). Single-threaded by design — the engine
-    owns it under its step lock, like every other piece of slot
-    bookkeeping."""
+    is the reserved trash page), REFCOUNTED for copy-on-write sharing
+    (docs/SERVING.md 'Prefix cache & per-request CFG'): ``alloc`` hands
+    out pages at refcount 1, ``retain`` maps an already-live page into
+    another owner's block table (physical sharing — the prefix cache's
+    warm hit), and ``release`` decrements, returning a page to the free
+    list only when its LAST reference drops. ``in_use`` counts physical
+    pages — a page shared by five block tables is one page of HBM —
+    which is what keeps /stats' ``pages_in_use`` and the modeled-vs-live
+    pool-bytes comparisons exact under sharing. Single-threaded by
+    design — the engine owns it under its step lock, like every other
+    piece of slot bookkeeping."""
 
     def __init__(self, num_pages: int):
         if num_pages < 2:
@@ -193,8 +240,10 @@ class PageAllocator:
         # placement makes failures reproducible
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
         self._free_set = set(self._free)   # O(1) double-release check
+        self._refs: Dict[int, int] = {}    # live page -> reference count
         self.peak_in_use = 0
         self.allocs = 0
+        self.retains = 0
 
     @property
     def capacity(self) -> int:
@@ -206,12 +255,30 @@ class PageAllocator:
 
     @property
     def in_use(self) -> int:
+        # PHYSICAL pages: a shared page counts once (refcounts never
+        # inflate residency — that is the whole point of sharing)
         return self.capacity - self.free
 
+    @property
+    def pages_shared(self) -> int:
+        """Physical pages mapped by more than one owner (refcount >= 2)
+        — the /stats sharing gauge."""
+        return sum(1 for r in self._refs.values() if r >= 2)
+
+    @property
+    def refs_saved(self) -> int:
+        """Pages of HBM sharing is currently saving: the sum over live
+        pages of (refcount - 1) — what a refcount-blind pool would have
+        allocated extra."""
+        return sum(r - 1 for r in self._refs.values())
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(int(page), 0)
+
     def alloc(self, n: int) -> List[int]:
-        """Hand out ``n`` physical page ids, or raise the typed
-        ``PagePoolExhausted`` (the caller decides between deferring the
-        request and evicting a victim)."""
+        """Hand out ``n`` physical page ids at refcount 1, or raise the
+        typed ``PagePoolExhausted`` (the caller decides between
+        deferring the request and evicting a victim)."""
         if n > self.free:
             raise PagePoolExhausted(structured_event(
                 "serve_page_exhausted", pages_needed=int(n),
@@ -219,23 +286,49 @@ class PageAllocator:
                 pages_capacity=self.capacity))
         out = [self._free.pop() for _ in range(n)]
         self._free_set.difference_update(out)
+        for p in out:
+            self._refs[p] = 1
         self.allocs += n
         self.peak_in_use = max(self.peak_in_use, self.in_use)
         return out
 
-    def release(self, pages: List[int]) -> None:
-        """Return pages to the free list (completion/expiry/eviction).
-        A double release is a hard error, not a warning: a page freed
-        twice would sit in the free list twice and eventually be handed
-        to TWO live slots, whose decode writes would silently interleave
-        in the shared page — wrong tokens with no signal. Fail at the
-        bug's site instead."""
+    def retain(self, pages: List[int]) -> None:
+        """Add one reference to each (already-live) page — the prefix
+        cache's warm hit mapping existing prompt pages into a new
+        slot's block table, and the index's own hold on an inserted
+        prefix. Retaining a free page is a hard error: its content is
+        gone the moment the next ``alloc`` hands it out."""
         for p in pages:
+            p = int(p)
             if not 1 <= p < self.num_pages:
                 raise ValueError(f"page id {p} was never allocatable")
-            if p in self._free_set:
+            if p in self._free_set or p not in self._refs:
                 raise ValueError(
-                    f"double release of page {p}: it is already free — "
-                    f"two slots would end up sharing it")
-            self._free.append(p)
-            self._free_set.add(p)
+                    f"retain of free page {p}: only a live (allocated) "
+                    f"page can gain a reference — a free page's content "
+                    f"is forfeit to the next alloc")
+            self._refs[p] += 1
+            self.retains += 1
+
+    def release(self, pages: List[int]) -> None:
+        """Drop one reference per page (completion/expiry/eviction/
+        prefix-cache eviction); a page returns to the free list only at
+        refcount zero — an eviction victim whose pages are still mapped
+        by a sibling's block table (or held by the prefix index) must
+        NOT hand them to the next allocation. Releasing past zero is
+        the typed ``PageReleaseUnderflow``: the refcounted form of the
+        double-release guard, failing at the bug's site instead of
+        letting two live slots interleave writes in one page."""
+        for p in pages:
+            p = int(p)
+            if not 1 <= p < self.num_pages:
+                raise ValueError(f"page id {p} was never allocatable")
+            if p in self._free_set or self._refs.get(p, 0) <= 0:
+                raise PageReleaseUnderflow(structured_event(
+                    "serve_page_release_underflow", page=p,
+                    pages_free=self.free, pages_in_use=self.in_use))
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
+                self._free_set.add(p)

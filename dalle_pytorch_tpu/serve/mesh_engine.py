@@ -150,11 +150,28 @@ class MeshEngine(Engine):
 
     def _jit_prefill_program(self, pre):
         import jax
-        # (cache, cur_tok, pos, active, rng, temp, topk_k, top_p) — same
-        # drift-proofing as the decode program, once per bucket
+        # (cache, cur_tok, pos, active, rng, temp, topk_k, top_p,
+        # h_last) — same drift-proofing as the decode program, once per
+        # bucket; h_last (the prefix cache's insert payload) replicates
+        # so a warm hit's first-token sample runs on whole rows
         rep = self._rep
         return jax.jit(pre, out_shardings=(
-            dict(self._kv_shardings), rep, rep, rep, rep, rep, rep, rep))
+            dict(self._kv_shardings), rep, rep, rep, rep, rep, rep, rep,
+            rep))
+
+    def _jit_warm_program(self, warm):
+        import jax
+        # (cur_tok, pos, active, rng, temp, topk_k, top_p) — the warm
+        # admission touches only replicated per-slot state
+        rep = self._rep
+        return jax.jit(warm, out_shardings=(rep,) * 7)
+
+    def _jit_pool_update(self, fn):
+        import jax
+        # the COW boundary-page fork returns the UPDATED pool: pin its
+        # shardings, or a propagation choice could drift the KV store's
+        # placement and silently retrace the fused decode program
+        return jax.jit(fn, out_shardings=dict(self._kv_shardings))
 
     # -- the byte-identity constraints --------------------------------------
 

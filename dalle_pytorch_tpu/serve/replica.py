@@ -174,6 +174,7 @@ class ReplicaSet:
                  num_pages: int = 0,
                  paged_attn: str = "gather",
                  sparse_reads: bool = False,
+                 prefix_cache: bool = False,
                  clock: Callable[[], float] = time.perf_counter,
                  heartbeat_s: float = 5.0,
                  bringup_policy=None,
@@ -278,7 +279,8 @@ class ReplicaSet:
             prefill_buckets=prefill_buckets, metrics=metrics,
             log_every=log_every, quantize_cache=quantize_cache,
             kv=kv, page_size=page_size, num_pages=num_pages,
-            paged_attn=paged_attn, sparse_reads=sparse_reads)
+            paged_attn=paged_attn, sparse_reads=sparse_reads,
+            prefix_cache=prefix_cache)
         self.worker_ckpt = worker_ckpt
         if self.isolation == "process":
             import numpy as np
@@ -298,7 +300,8 @@ class ReplicaSet:
                 prefill_buckets=prefill_buckets,
                 quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
-                paged_attn=paged_attn, sparse_reads=sparse_reads)
+                paged_attn=paged_attn, sparse_reads=sparse_reads,
+                prefix_cache=prefix_cache)
             # routing needs page math without an Engine in-process:
             # mirror the engine's bucket/page-size resolution
             self._buckets = (S.prefill_buckets(cfg.text_seq_len)

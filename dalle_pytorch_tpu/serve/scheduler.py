@@ -124,14 +124,25 @@ class SamplingParams:
 @dataclasses.dataclass(frozen=True)
 class Request:
     """One generation request: ``codes`` is the (unpadded) prompt token
-    ids, exactly what ``generate_images`` takes as one text row."""
+    ids, exactly what ``generate_images`` takes as one text row.
+    ``cfg_scale > 0`` asks for classifier-free guidance — the engine
+    admits a cond/uncond slot pair and image tokens sample from
+    ``l_u + cfg_scale * (l_c - l_u)``, exactly ``generate_images``'
+    ``guidance`` knob (1.0 reduces to conditional sampling but still
+    pays the pair; 0, the default, is off)."""
     codes: Tuple[int, ...]
     seed: int = 0
     sampling: SamplingParams = SamplingParams()
     priority: int = 0                    # lower runs first
     deadline_s: Optional[float] = None   # relative to submit time
+    cfg_scale: float = 0.0               # classifier-free guidance
     request_id: int = -1                 # assigned by the queue
     submit_t: float = 0.0                # perf_counter, set by the queue
+
+    def __post_init__(self):
+        if self.cfg_scale < 0:
+            raise ValueError(f"cfg_scale must be >= 0, got "
+                             f"{self.cfg_scale}")
 
     @property
     def deadline_t(self) -> Optional[float]:
@@ -158,6 +169,7 @@ class Request:
             "top_p": float(self.sampling.top_p),
             "deadline_left_s": (None if self.deadline_s is None
                                 else max(self.deadline_t - now, 0.0)),
+            "cfg_scale": float(self.cfg_scale),
         }
 
     @classmethod
@@ -176,6 +188,9 @@ class Request:
                 top_p=float(d["top_p"])),
             priority=int(d["priority"]),
             deadline_s=None if deadline is None else float(deadline),
+            # .get: frames from a pre-guidance peer simply decode as
+            # unguided instead of failing the whole attach
+            cfg_scale=float(d.get("cfg_scale", 0.0)),
             request_id=int(d["id"]),
             submit_t=float(now))
 
@@ -453,6 +468,15 @@ class RequestQueue:
         uncompiled?) without reaching into the heap layout."""
         with self._lock:
             return [len(entry[2].request.codes) for entry in self._heap]
+
+    def pending_prompt_codes(self) -> List[Tuple[Tuple[int, ...], float]]:
+        """(codes, cfg_scale) of everything currently queued — the
+        prefix-cache half of the engine's ``compile_pending`` probe
+        (could a queued prompt be the first WARM admission, whose
+        program has its own one-time compile?)."""
+        with self._lock:
+            return [(entry[2].request.codes, entry[2].request.cfg_scale)
+                    for entry in self._heap]
 
     def drain(self) -> List[RequestHandle]:
         """Remove and return everything still queued (shutdown path — the

@@ -64,6 +64,8 @@ class InferenceServer:
                  num_pages: int = 0,
                  paged_attn: str = "gather",
                  sparse_reads: bool = False,
+                 prefix_cache: bool = False,
+                 default_cfg_scale: float = 0.0,
                  replicas: int = 1,
                  mesh_devices: int = 1,
                  heartbeat_s: float = 5.0,
@@ -84,6 +86,12 @@ class InferenceServer:
         self.cfg = cfg
         self.metrics = metrics
         self.encode = encode
+        # server-wide guidance default: a request that doesn't carry
+        # its own cfg_scale samples with this one (0 = unguided)
+        self.default_cfg_scale = float(default_cfg_scale)
+        if self.default_cfg_scale < 0:
+            raise ValueError(f"default_cfg_scale must be >= 0, got "
+                             f"{default_cfg_scale}")
         self.init_deadline_s = init_deadline_s
         self.init_retries = init_retries
         self.replicas = int(replicas)
@@ -144,6 +152,7 @@ class InferenceServer:
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
                 paged_attn=paged_attn, sparse_reads=sparse_reads,
+                prefix_cache=prefix_cache,
                 heartbeat_s=heartbeat_s, isolation=isolation,
                 child_rss_limit_mb=child_rss_limit_mb,
                 transport=transport, worker_endpoint=worker_endpoint,
@@ -170,7 +179,8 @@ class InferenceServer:
                 complete=self._on_decoded, metrics=metrics,
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
-                paged_attn=paged_attn, sparse_reads=sparse_reads)
+                paged_attn=paged_attn, sparse_reads=sparse_reads,
+                prefix_cache=prefix_cache)
         else:
             self.engine = engine_mod.Engine(
                 params, cfg, self.queue, num_slots=num_slots,
@@ -178,7 +188,8 @@ class InferenceServer:
                 complete=self._on_decoded, metrics=metrics,
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
-                paged_attn=paged_attn, sparse_reads=sparse_reads)
+                paged_attn=paged_attn, sparse_reads=sparse_reads,
+                prefix_cache=prefix_cache)
 
         # bounded window: p50/p95 over the last 10k completions — an
         # unbounded list would grow (and re-sort under the lock) forever
@@ -283,17 +294,24 @@ class InferenceServer:
     def submit(self, codes, *, seed: int = 0, temperature: float = 1.0,
                filter_thres: float = 0.5, top_p: float = 0.0,
                priority: int = 0,
-               deadline_s: Optional[float] = None) -> S.RequestHandle:
+               deadline_s: Optional[float] = None,
+               cfg_scale: Optional[float] = None) -> S.RequestHandle:
         """Enqueue one generation request. Raises a typed, structured
         ``scheduler.ServeRejected`` subclass: ``QueueFull`` on
         backpressure, ``InvalidRequest`` for an empty or over-long
-        prompt, ``QueueClosed`` after ``close()``."""
+        prompt, ``QueueClosed`` after ``close()``. ``cfg_scale``
+        (default: the server's ``default_cfg_scale``) > 0 samples with
+        classifier-free guidance — the engine runs a cond/uncond slot
+        pair for this request alone; no dedicated engine needed."""
+        if cfg_scale is None:
+            cfg_scale = self.default_cfg_scale
         return self.queue.submit(S.Request(
             codes=tuple(int(c) for c in codes), seed=seed,
             sampling=S.SamplingParams(temperature=temperature,
                                       filter_thres=filter_thres,
                                       top_p=top_p),
-            priority=priority, deadline_s=deadline_s))
+            priority=priority, deadline_s=deadline_s,
+            cfg_scale=float(cfg_scale)))
 
     def generate(self, codes, timeout: Optional[float] = None,
                  **kwargs) -> S.Result:
@@ -410,7 +428,8 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     raise ValueError("need non-empty 'codes' or 'caption'")
                 kwargs = {k: req[k] for k in
                           ("seed", "temperature", "filter_thres", "top_p",
-                           "priority", "deadline_s") if k in req}
+                           "priority", "deadline_s", "cfg_scale")
+                          if k in req}
                 handle = server.submit(codes, **kwargs)
             except S.InvalidRequest as e:
                 self._send(400, e.record)       # caller error, not load

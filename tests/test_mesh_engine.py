@@ -188,6 +188,29 @@ class TestMeshByteIdentity:
         np.testing.assert_array_equal(
             np.asarray(h2.result(timeout=60).tokens), ref[2])
 
+    def test_prefix_cache_warm_hit_byte_identical(self, bundle):
+        """The prefix cache composes with the head-sharded pool: a warm
+        hit on the mesh — shared pages mapped into the replicated block
+        tables, the COW boundary fork through the sharding-pinned pool
+        update, first token from the cached (replicated) h_last row —
+        emits tokens byte-identical to the single-device prefix-blind
+        engine, with one decode trace and a guided pair riding along."""
+        params, _ = bundle
+        p8 = (4, 1, 2, 3, 5, 6, 7, 2)
+        reqs = [Request(codes=p8, seed=31), Request(codes=p8, seed=37),
+                Request(codes=p8, seed=41, cfg_scale=1.5)]
+        kw = dict(kv="paged", page_size=8)
+        _, ref = engine_tokens(params, Engine, reqs=reqs, **kw)
+        engine, toks = engine_tokens(params, MeshEngine,
+                                     devices=mesh_devices(),
+                                     prefix_cache=True, reqs=reqs, **kw)
+        assert engine.decode_traces == 1
+        assert engine.kv_sharded
+        assert engine.prefix_hits >= 1    # the same-prompt fan-out hit
+        assert engine.cfg_pairs == 1
+        for a, b in zip(ref, toks):
+            np.testing.assert_array_equal(a, b)
+
 
 class TestMeshSurfaceAndSpecs:
     def test_kernel_attn_gated_typed(self, bundle):
