@@ -235,6 +235,46 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue_depth", type=int, default=64,
                    help="bounded admission queue; submissions past this "
                         "are rejected with a structured 429")
+    p.add_argument("--admin_token", type=str, default="",
+                   help="bearer token for the POST /admin/scale "
+                        "operator endpoint (add/remove/drain/undrain "
+                        "replicas, rolling weight upgrade, status). "
+                        "Default: generated and printed at startup")
+    p.add_argument("--max_replicas", type=int, default=0,
+                   help="hard cap on fleet width for runtime scale-out "
+                        "(POST /admin/scale {\"op\": \"add\"} and the "
+                        "autoscaler): every replica allocates its own "
+                        "KV page pool, so width is an HBM page budget "
+                        "— growing past the cap is a typed 409, never "
+                        "a silent clamp. 0 = no runtime growth beyond "
+                        "--replicas")
+    p.add_argument("--min_replicas", type=int, default=0,
+                   help="autoscaler floor (0 = --replicas): scale-in "
+                        "never retires below this many replicas")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the load-driven autoscaler "
+                        "(serve/autoscale.py): watch slot occupancy, "
+                        "queue depth, and page pressure, and add/"
+                        "remove replicas through the same scale API "
+                        "the admin endpoint uses — hysteresis + "
+                        "cooldown, capped by --min_replicas/"
+                        "--max_replicas, every decision a structured "
+                        "autoscale_decision event. Requires "
+                        "--max_replicas > --replicas (headroom to "
+                        "grow into)")
+    p.add_argument("--autoscale_high", type=float, default=0.85,
+                   help="autoscaler: mean slot occupancy above this "
+                        "(sustained) triggers scale-out")
+    p.add_argument("--autoscale_low", type=float, default=0.25,
+                   help="autoscaler: occupancy below this with an "
+                        "empty queue (sustained) triggers scale-in")
+    p.add_argument("--autoscale_cooldown_s", type=float, default=10.0,
+                   help="autoscaler: silence after any scale action "
+                        "(a fresh replica needs time to compile and "
+                        "drain the backlog before the signals are "
+                        "believable again)")
+    p.add_argument("--autoscale_interval_s", type=float, default=1.0,
+                   help="autoscaler: seconds between policy ticks")
     p.add_argument("--host", type=str, default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--metrics", type=str, default="",
@@ -304,6 +344,32 @@ def main(argv=None):
         except ValueError:
             raise SystemExit(f"--prefill_buckets must be comma-separated "
                              f"ints, got {args.prefill_buckets!r}")
+    autoscale = None
+    if args.autoscale:
+        from dalle_pytorch_tpu.serve.autoscale import AutoscalePolicy
+        if args.max_replicas <= args.replicas:
+            raise SystemExit(
+                "--autoscale needs --max_replicas > --replicas "
+                "(headroom for the scaler to grow into)")
+        autoscale = AutoscalePolicy(
+            min_replicas=args.min_replicas or args.replicas,
+            max_replicas=args.max_replicas,
+            high_occupancy=args.autoscale_high,
+            low_occupancy=args.autoscale_low,
+            cooldown_s=args.autoscale_cooldown_s,
+            interval_s=args.autoscale_interval_s)
+
+    def load_weights(path: str):
+        # the admin endpoint's rolling-upgrade loader: resolve +
+        # validate + restore exactly the way a checkpoint-path worker
+        # does (serve/worker.py), re-applying this server's startup
+        # transforms — so the upgraded fleet serves weights
+        # byte-identical to a fresh `serve_dalle` on the new checkpoint
+        from dalle_pytorch_tpu.serve.worker import load_ckpt_params
+        return jax.device_put(load_ckpt_params({
+            "ckpt_path": path, "ckpt_use_ema": args.use_ema,
+            "ckpt_quantize": args.quantize}))
+
     if args.worker_ckpt and (args.use_ema or args.quantize != "none"):
         # the attach spec carries the SAME transforms the parent just
         # applied to its local copy: each worker re-applies them after
@@ -322,6 +388,14 @@ def main(argv=None):
         prefix_cache=args.prefix_cache,
         default_cfg_scale=args.cfg_scale,
         replicas=args.replicas, mesh_devices=args.mesh_devices,
+        weights_version=f"{args.name}_dalle@{args.dalle_epoch}",
+        # the documented default: --max_replicas 0 means NO runtime
+        # growth beyond --replicas, not "uncapped" — cap at the
+        # startup width so a scripted add loop cannot exhaust HBM
+        max_replicas=args.max_replicas or args.replicas,
+        autoscale=autoscale,
+        admin_token=args.admin_token or None,
+        load_weights=load_weights,
         heartbeat_s=args.heartbeat_s,
         isolation=args.isolation,
         child_rss_limit_mb=args.child_rss_limit_mb,
@@ -354,6 +428,17 @@ def main(argv=None):
             f"a worker with: DALLE_WORKER_TOKEN={listener.token} "
             f"python -m dalle_pytorch_tpu.serve.worker --connect "
             f"{listener.advertise_endpoint} --index N")
+    if server._is_set:
+        scale_desc = "" if not args.max_replicas \
+            else f", max_replicas {args.max_replicas}"
+        auto_desc = "" if autoscale is None \
+            else (f", autoscaler {autoscale.min_replicas}.."
+                  f"{autoscale.max_replicas}")
+        say(f"admin: POST /admin/scale with Authorization: Bearer "
+            f"{server.admin_token}{scale_desc}{auto_desc} — e.g. "
+            f"curl -s localhost:{args.port}/admin/scale -H "
+            f"'Authorization: Bearer {server.admin_token}' -d "
+            f"'{{\"op\": \"status\"}}'")
     serve_http(server, args.host, args.port)
 
 

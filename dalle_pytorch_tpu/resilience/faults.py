@@ -27,6 +27,14 @@ Simulated faults (pytest -m faults exercises each):
       exactly once per activation per replica, so a restarted child
       never re-fires its own kill (fire-once is kept parent-side: the
       child's ``_fired`` set dies with it).
+  * ELASTIC reshape faults              -> on_scale_add_bringup /
+      on_upgrade_drain / on_canary_gate
+      a replica killed mid-``add_replica`` bring-up (the scale-out slot
+      circuit-breaks; survivors untouched), a real SIGKILL of the
+      replica ``rolling_upgrade`` is draining (the planned drain races
+      an unplanned death; reclaim-from-shadow still loses nothing), and
+      a canary that fails the upgrade health gate (typed UpgradeAborted
+      + rollback, fleet left on the old version).
   * NETWORK faults (socket transport)   -> on_worker_chunk
       connection reset mid-frame (RST after half a frame), torn frame
       (half a frame then FIN), stalled socket (open but silent),
@@ -119,6 +127,28 @@ class FaultPlan:
     replica_stall_socket_at_chunk: int = -1
     replica_dup_frame_at_chunk: int = -1
     replica_reorder_frames_at_chunk: int = -1
+    # ELASTIC-fleet faults (runtime scale-out/in + rolling weight
+    # hot-swap, serve/replica.py) — the reshape paths have their own
+    # failure points, each of which must degrade typed and zero-loss:
+    #   * scale_add_bringup_crash: kill the first N bring-up attempts
+    #     of a replica born from ``add_replica`` (the scale-out path's
+    #     own flaky-bring-up row — the new slot must circuit-break and
+    #     retry WITHOUT disturbing the serving survivors, and the
+    #     in-flight burst must lose nothing);
+    #   * upgrade_drain_sigkill_replica: real SIGKILL of THIS replica's
+    #     child just as ``rolling_upgrade`` starts draining it — the
+    #     planned drain races an unplanned death, and the upgrade must
+    #     absorb it (reclaim from the shadow, zero loss) and keep
+    #     cycling (process isolation only: a thread cannot survive its
+    #     own SIGKILL, the hook raises FaultInjected on a thread set);
+    #   * upgrade_canary_fail_replica: fail the canary health gate on
+    #     THIS replica's freshly upgraded engine — rolling_upgrade must
+    #     abort typed (UpgradeAborted), roll the replica back to the
+    #     old weights, and leave the WHOLE fleet serving the old
+    #     version. All fire at most once; -1/0 = off.
+    scale_add_bringup_crash: int = 0
+    upgrade_drain_sigkill_replica: int = -1
+    upgrade_canary_fail_replica: int = -1
 
 
 _active: Optional[FaultPlan] = None
@@ -408,6 +438,63 @@ def on_worker_chunk(replica: int, chunk: int, *,
         sender.seq += 2
         emit_frame(_heartbeat_frame(a + 1))
         emit_frame(_heartbeat_frame(a))
+
+
+def on_scale_add_bringup(replica: int, attempt: int) -> None:
+    """Inside the supervisor's bring-up path, ONLY for a replica born
+    from ``add_replica`` (runtime scale-out): fail its first
+    ``scale_add_bringup_crash`` bring-up attempts — the replica 'killed
+    mid-add_replica bring-up' row. The new slot must circuit-break with
+    backoff and eventually join routing; the serving survivors and
+    every in-flight request must be untouched throughout."""
+    p = _active
+    if p is None:
+        return
+    if attempt < p.scale_add_bringup_crash:
+        raise FaultInjected(
+            f"injected scale-out bring-up kill (replica {replica}, "
+            f"attempt {attempt})")
+
+
+def on_upgrade_drain(replica: int, pid: Optional[int]) -> None:
+    """Called by ``rolling_upgrade`` just BEFORE it drains ``replica``:
+    with ``upgrade_drain_sigkill_replica`` targeting it, deliver a REAL
+    SIGKILL to the replica's child process — the planned drain races an
+    unplanned death, and the upgrade must reclaim from the parent-side
+    shadow (the corpse answers nothing), lose zero requests, and keep
+    cycling. Needs process isolation: on a thread replica there is no
+    process to kill, and silently skipping would make the test pass
+    vacuously — raise instead."""
+    p = _active
+    if p is None or replica != p.upgrade_drain_sigkill_replica \
+            or not _once("upgrade_drain_sigkill"):
+        return
+    if pid is None:
+        raise FaultInjected(
+            "upgrade_drain_sigkill_replica fired but the replica has no "
+            "child process to kill — run with isolation='process', or "
+            "this fault proves nothing")
+    os.kill(pid, signal.SIGKILL)
+    # let the death become OBSERVABLE before the drain proceeds: the
+    # point of this row is that the upgrade finds a corpse where it
+    # expected a live replica (died-on-its-own, decoded exit SIGKILL),
+    # not that our kill races the supervisor's own fence kill
+    time.sleep(0.3)
+
+
+def on_canary_gate(replica: int, version: str) -> None:
+    """Inside ``rolling_upgrade``'s health gate, after ``replica``'s
+    fresh engine answered its canary requests: fail the gate for
+    ``upgrade_canary_fail_replica`` — the upgrade must abort with the
+    typed ``UpgradeAborted``, restore this replica to the OLD weights,
+    and leave the whole fleet serving the old version."""
+    p = _active
+    if p is None or replica != p.upgrade_canary_fail_replica \
+            or not _once("upgrade_canary_fail"):
+        return
+    raise FaultInjected(
+        f"injected canary health-gate failure (replica {replica}, "
+        f"version {version!r})")
 
 
 def on_replica_bringup(replica: int, attempt: int) -> None:

@@ -23,9 +23,13 @@ def __getattr__(name):
     if name == "Engine":
         from dalle_pytorch_tpu.serve.engine import Engine
         return Engine
-    if name == "ReplicaSet":
-        from dalle_pytorch_tpu.serve.replica import ReplicaSet
-        return ReplicaSet
+    if name in ("ReplicaSet", "ScaleError", "UpgradeAborted",
+                "ReplayVersionMismatch"):
+        from dalle_pytorch_tpu.serve import replica
+        return getattr(replica, name)
+    if name in ("Autoscaler", "AutoscalePolicy"):
+        from dalle_pytorch_tpu.serve import autoscale
+        return getattr(autoscale, name)
     if name == "MeshEngine":
         from dalle_pytorch_tpu.serve.mesh_engine import MeshEngine
         return MeshEngine

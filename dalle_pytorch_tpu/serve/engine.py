@@ -227,6 +227,7 @@ class Engine:
                  prefix_cache: bool = False,
                  prefix_entries: int = 256,
                  model_version: str = "0",
+                 weights_version: str = "0",
                  time_admissions: bool = False,
                  clock: Callable[[], float] = time.perf_counter,
                  device=None):
@@ -423,6 +424,14 @@ class Engine:
              jnp.zeros((S_,), bool)))
         self._cfg_dirty = False
         self.slots: List[Optional[_Slot]] = [None] * S_
+        # the weight generation this engine serves: stamped on every
+        # Result it fulfils (rolling weight hot-swap makes "which
+        # weights produced these tokens" a per-replica fact, and the
+        # byte-identity contract holds PER version). Distinct from
+        # model_version below, which keys the prefix cache — though the
+        # replica set feeds the same string to both, so an upgraded
+        # replica can never serve another generation's cached prompt KV.
+        self.weights_version = str(weights_version)
         # the prefix cache (kv='paged' only): content-addressed prompt
         # KV sharing over the refcounted allocator
         self.model_version = str(model_version)
@@ -918,6 +927,7 @@ class Engine:
     def _finish(self, handle: S.RequestHandle, result: S.Result) -> None:
         if self.fenced:
             return
+        result.weights_version = self.weights_version
         if result.status == S.OK and self.complete is not None:
             self.complete(handle, result)
         else:
@@ -1871,6 +1881,7 @@ class Engine:
                 slot.handle.fulfill(S.Result(
                     status=status, request_id=req.request_id,
                     reason=reason,
+                    weights_version=self.weights_version,
                     queued_s=round(slot.t_admit - req.submit_t, 6),
                     total_s=round(now - req.submit_t, 6)))
                 self._free_slot(i)

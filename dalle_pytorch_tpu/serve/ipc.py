@@ -189,6 +189,14 @@ def engine_snapshot(engine, chunks: int, rss_mb: int,
         "t": time.perf_counter(),
         "pages_free": (int(engine.alloc.free)
                        if engine.kv == "paged" else -1),
+        # the engine's head-of-line page reservation, if any: the oldest
+        # page-deferred request's (id, pages needed). Mirrored so the
+        # parent can hand the reservation back to the shared queue when
+        # this replica is fenced/drained — a retiring replica must not
+        # take a waiting request's page claim to the grave with it.
+        "hol": (None if engine.kv != "paged"
+                or getattr(engine, "_hol_rid", None) is None
+                else [int(engine._hol_rid), int(engine._hol_need)]),
     }
     return snap
 
@@ -207,11 +215,16 @@ def _snap_fields(payload: dict):
         counters = {k: int(raw.get(k, 0)) for k in COUNTERS}
         progress = {int(k): int(v)
                     for k, v in payload["progress"].items()}
+        # .get: a pre-elastic worker's snapshots carry no hol field —
+        # decode as "no reservation" instead of poisoning the stream
+        raw_hol = payload.get("hol")
+        hol = (None if raw_hol is None
+               else (int(raw_hol[0]), int(raw_hol[1])))
         return (counters, progress, int(payload["active_slots"]),
                 int(payload["queued"]), int(payload["chunks"]),
                 bool(payload["compiling"]), int(payload["rss_mb"]),
-                float(payload["t"]), int(payload["pages_free"]))
-    except (KeyError, TypeError, ValueError) as e:
+                float(payload["t"]), int(payload["pages_free"]), hol)
+    except (KeyError, TypeError, ValueError, IndexError) as e:
         raise IPCError(f"malformed snapshot: {e!r}") from None
 
 
@@ -379,6 +392,7 @@ class ChildEngineClient:
         self.poisoned = False           # protocol error: fence me
         self.bye = False                # clean goodbye received
         self.last_error = ""
+        self.worker_weights_version = ""    # READY announcement
 
         # the shadow: every handle routed here and not yet resolved —
         # the reclaim surface, owned and trusted by the parent only
@@ -393,6 +407,7 @@ class ChildEngineClient:
         self.compiling = True           # bring-up IS a compile phase
         self.rss_mb = 0
         self.pages_free = -1
+        self.hol = None                 # (rid, need) per the last frame
         self.last_heartbeat = self.clock()
         self.last_frame_t = self.clock()    # ANY decoded frame stamps it
         self.stats_reply: Optional[dict] = None
@@ -536,6 +551,12 @@ class ChildEngineClient:
                 self.rss_mb = int(payload.get("rss_mb", 0))
             except (TypeError, ValueError):
                 raise IPCError(f"malformed READY: {payload!r}") from None
+            # what generation the worker SAYS it serves (rolling
+            # upgrades re-spawn workers on new weights; the replica
+            # set verifies the attach landed on the one it asked for).
+            # .get: a pre-elastic worker simply doesn't announce.
+            self.worker_weights_version = \
+                str(payload.get("weights_version") or "")
         elif kind in (HEARTBEAT, HARVEST):
             # results FIRST, snapshot second: the snapshot in a frame
             # counts the completions whose results ride the same frame,
@@ -580,7 +601,7 @@ class ChildEngineClient:
     def _absorb_snapshot(self, snap: dict) -> None:
         (self.counter_state, self.progress, self.active, self.queued,
          self.chunks, self.compiling, self.rss_mb, stamp,
-         self.pages_free) = _snap_fields(snap)
+         self.pages_free, self.hol) = _snap_fields(snap)
         self.ipc_lag_s.append(max(time.perf_counter() - stamp, 0.0))
 
     # -- supervision surface ------------------------------------------------

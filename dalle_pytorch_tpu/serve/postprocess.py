@@ -148,6 +148,7 @@ class PostProcessor:
                 result = S.Result(
                     status=S.ERROR, request_id=result.request_id,
                     tokens=result.tokens, reason=f"postprocess: {e}",
+                    weights_version=result.weights_version,
                     queued_s=result.queued_s, decode_s=result.decode_s,
                     total_s=round(result.total_s
                                   + (time.perf_counter() - t0), 6))

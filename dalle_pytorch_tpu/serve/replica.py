@@ -106,10 +106,40 @@ torn-frame detection turn every network failure mode — reset
 mid-frame, partial frame, stalled link, duplicated or reordered
 delivery — into the same typed fence + byte-identical replay a local
 `kill -9` gets (docs/SERVING.md 'Host isolation & socket transport').
+
+ELASTIC FLEET. The set is a MOVING TARGET at runtime (docs/SERVING.md
+'Elastic fleet'): ``add_replica()`` appends a new supervised slot —
+thread, spawned child, launcher-started or hand-started remote worker,
+whichever shape the set already runs — that joins routing atomically
+once serving (process children once READY); ``remove_replica(i)``
+drains in-flight work to the survivors (the same fence→reclaim→replay
+that makes failover zero-loss) and RETIRES the slot for good. Illegal
+transitions are typed ``ScaleError``\\ s, never partial states: removing
+the last live replica, growing past ``max_replicas`` (the page-budget
+cap — every replica allocates its own KV pool), reshaping mid-upgrade.
+``rolling_upgrade(version=...)`` hot-swaps weights replica-by-replica
+with zero dropped requests: drain (in-flight work replays on survivors
+still serving the OLD weights), re-bring-up on the new weights (new
+params pytree, or a new ``worker_ckpt`` path for checkpoint-path
+attach), health-gate behind N CANARY requests decoded by the new engine
+alone — token-exact against the first upgraded replica's canary tokens,
+so every replica of a generation provably samples identical streams —
+and only then rejoin routing. A canary or bring-up failure ABORTS the
+upgrade typed (``UpgradeAborted``): the replica rolls back to the old
+weights and the whole fleet keeps serving the old version. Every
+``Result`` is stamped with the ``weights_version`` that decoded it, and
+failover replay is VERSION-PINNED: a request reclaimed mid-upgrade
+replays only on a replica of the generation it started on (same-seed
+tokens are byte-identical PER version; a newer generation's logits are
+not) — the pin is released, with a structured event, only when that
+generation has left the fleet entirely, because zero-loss outranks a
+stale pin. ``serve/autoscale.py``'s policy loop drives the same two
+scale calls off /stats occupancy, queue depth, and page pressure.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Callable, List, Optional
@@ -121,9 +151,61 @@ from dalle_pytorch_tpu.serve.engine import COUNTERS as _COUNTERS
 RUNNING = "running"
 BROKEN = "broken"        # circuit open: waiting out the bring-up backoff
 DRAINED = "drained"      # operator drain: down until undrain_replica()
+RETIRED = "retired"      # scale-in tombstone: the slot never comes back
+#                          (indices stay stable; routing, supervision,
+#                          and capacity all skip it forever)
 
 ISOLATION_MODES = ("thread", "process")
 TRANSPORT_MODES = ("pipe", "socket")
+
+
+class ScaleError(RuntimeError):
+    """Typed rejection of an illegal fleet reshape: removing the last
+    live replica, adding past the ``max_replicas`` page-budget cap,
+    naming a retired/unknown slot, or scaling while a rolling upgrade
+    owns the fleet. ``record`` is the structured event (kind
+    ``serve_scale_reject``) — the operator API's machine-readable
+    half, mirroring ``scheduler.ServeRejected``."""
+
+    def __init__(self, record: dict):
+        super().__init__(f"{record.get('reason', 'scale rejected')} "
+                         f"(op={record.get('op')})")
+        self.record = record
+
+
+class UpgradeAborted(RuntimeError):
+    """A rolling upgrade that could not complete safely: a canary
+    failed the health gate, the new weights' bring-up failed or timed
+    out, or the fresh replica died mid-canary. By the time this is
+    raised the aborting replica has been rolled back to the OLD
+    weights and the whole fleet serves the old version — the abort is
+    an event, never a mixed-version end state. ``record`` is the
+    structured event (kind ``serve_upgrade_aborted``)."""
+
+    def __init__(self, record: dict):
+        super().__init__(
+            f"rolling upgrade to {record.get('to')!r} aborted at "
+            f"replica {record.get('replica')}: {record.get('error')} "
+            f"(fleet left on {record.get('fleet_version')!r})")
+        self.record = record
+
+
+class ReplayVersionMismatch(RuntimeError):
+    """Invariant guard on version-pinned replay: a handle pinned to one
+    weights generation reached a replica serving another. The router's
+    candidate filter makes this unreachable in normal operation (a
+    pinned request is HELD in the shared queue until a same-version
+    replica has capacity, or the pin is released once the generation
+    left the fleet); raising typed here — instead of silently decoding
+    on the wrong weights — is what keeps 'byte-identical per
+    weights_version' a contract rather than a hope."""
+
+    def __init__(self, record: dict):
+        super().__init__(
+            f"request {record.get('request_id')} is pinned to weights "
+            f"{record.get('pinned')!r} but was offered replica "
+            f"{record.get('replica')} on {record.get('version')!r}")
+        self.record = record
 
 
 class _Replica:
@@ -134,9 +216,10 @@ class _Replica:
     __slots__ = ("index", "state", "engine", "queue", "thread", "stop",
                  "device", "attempt", "bringups", "next_bringup_t",
                  "last_error", "dead", "await_ready", "last_exit",
-                 "conns")
+                 "conns", "version", "canary", "params_override",
+                 "ckpt_override", "born_scaled")
 
-    def __init__(self, index: int, device=None):
+    def __init__(self, index: int, device=None, version: str = "0"):
         self.index = index
         self.state = BROKEN          # until the first bring-up succeeds
         self.engine = None
@@ -152,6 +235,12 @@ class _Replica:
         self.await_ready = False     # process child spawned, READY due
         self.last_exit = ""          # decoded exit of the last child
         self.conns = 0               # workers that reached READY here
+        self.version = str(version)  # weights generation this slot serves
+        self.canary = False          # upgrading: serving canaries only,
+        #                              excluded from routing until gated
+        self.params_override = None  # upgrade: bring up on THESE params
+        self.ckpt_override = None    # upgrade: ... or this ckpt path
+        self.born_scaled = False     # created by add_replica (faults)
 
 
 class ReplicaSet:
@@ -191,7 +280,9 @@ class ReplicaSet:
                  worker_ckpt: Optional[str] = None,
                  worker_use_ema: bool = False,
                  worker_quantize: str = "none",
-                 devices_per_replica: int = 1):
+                 devices_per_replica: int = 1,
+                 weights_version: str = "0",
+                 max_replicas: int = 0):
         import jax
 
         from dalle_pytorch_tpu.resilience import faults
@@ -199,6 +290,12 @@ class ReplicaSet:
 
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.weights_version = str(weights_version)
+        self.max_replicas = int(max_replicas)
+        if self.max_replicas and self.max_replicas < replicas:
+            raise ValueError(
+                f"max_replicas={max_replicas} is below the initial "
+                f"replica count {replicas}")
         if isolation not in ISOLATION_MODES:
             raise ValueError(f"isolation must be one of "
                              f"{ISOLATION_MODES}, got {isolation!r}")
@@ -323,24 +420,9 @@ class ReplicaSet:
         self._placed = place_on_devices and len(devices) > 1
         self.replicas: List[_Replica] = []
         for i in range(self.n_replicas):
-            if self.devices_per_replica > 1 \
-                    and self.isolation != "process":
-                # replica = mesh SLICE: devices [i*m, (i+1)*m) (wrapped
-                # like the single-chip i % n placement when the host
-                # holds fewer slices than replicas). A mesh engine is
-                # always pinned to its slice — unpinned, every replica
-                # would shard over ALL devices and serialize against
-                # the others. Process mode resolves the slice in the
-                # WORKER from its own jax client (serve/worker.py): a
-                # remote worker's devices live on its host, and the
-                # parent — possibly a 0-accelerator head node — must
-                # not gate construction on holding them locally.
-                from dalle_pytorch_tpu.parallel import serve_specs as SS
-                dev = SS.slice_devices(devices, i,
-                                       self.devices_per_replica)
-            else:
-                dev = devices[i % len(devices)] if self._placed else None
-            self.replicas.append(_Replica(i, device=dev))
+            self.replicas.append(_Replica(
+                i, device=self._device_for(i),
+                version=self.weights_version))
 
         # supervisor counters + retired-engine counter base: a fenced
         # engine's numbers are folded in here at reclaim time (minus the
@@ -352,6 +434,25 @@ class ReplicaSet:
         self.reclaimed = 0
         self.expired = 0             # router-side queued-deadline reaps
         self.bringup_failures = 0
+        # elastic-fleet bookkeeping (scale API + rolling upgrade)
+        self.scale_outs = 0
+        self.scale_ins = 0
+        self.upgrades = 0            # completed rolling upgrades
+        self._upgrading = False      # one reshape owner at a time
+        # set-level HOL page reservations handed back by fenced/drained
+        # replicas: {request_id: pages_needed}. The router routes such a
+        # request with its EXACT (prefix-aware) need instead of the
+        # blind full-span guess, and the reservation clears the moment
+        # it lands on a replica (whose own _hol floor takes over).
+        self._hol_handoff: dict = {}
+        self.hol_handoffs = 0
+        # version-pinned replay: rids currently HELD for a same-version
+        # replica (event de-dup), and the canary machinery's id space —
+        # negative, so canary requests can never collide with the
+        # shared queue's monotonically increasing request ids
+        self._version_holds: set = set()
+        self._canary_ids = itertools.count(-1000, -1)
+        self._canary_ref: dict = {}  # (version, k) -> token reference
         self._ctl_lock = threading.Lock()
         self._started = False
         self._ctl_thread: Optional[threading.Thread] = None
@@ -371,6 +472,39 @@ class ReplicaSet:
             except Exception:   # noqa: BLE001 — observability must never
                 pass            # take down supervision
 
+    def _device_for(self, i: int):
+        """Placement for replica ``i`` — shared by the constructor and
+        ``add_replica`` (a replica born at runtime places exactly like
+        one born at startup)."""
+        import jax
+        devices = jax.devices()
+        if self.devices_per_replica > 1 and self.isolation != "process":
+            # replica = mesh SLICE: devices [i*m, (i+1)*m) (wrapped
+            # like the single-chip i % n placement when the host
+            # holds fewer slices than replicas). A mesh engine is
+            # always pinned to its slice — unpinned, every replica
+            # would shard over ALL devices and serialize against
+            # the others. Process mode resolves the slice in the
+            # WORKER from its own jax client (serve/worker.py): a
+            # remote worker's devices live on its host, and the
+            # parent — possibly a 0-accelerator head node — must
+            # not gate construction on holding them locally.
+            from dalle_pytorch_tpu.parallel import serve_specs as SS
+            return SS.slice_devices(devices, i, self.devices_per_replica)
+        return devices[i % len(devices)] if self._placed else None
+
+    def _on_complete(self, handle: S.RequestHandle,
+                     result: S.Result) -> None:
+        """Every thread-mode engine's ``complete`` hook: canary handles
+        (rolling upgrade's health-gate probes) are fulfilled directly —
+        they must never reach the server's postprocess stage or latency
+        accounting — everything else flows to the set's downstream
+        ``complete`` exactly as before."""
+        if getattr(handle, "canary", False) or self.complete is None:
+            handle.fulfill(result)
+        else:
+            self.complete(handle, result)
+
     # -- bring-up / circuit breaker -----------------------------------------
 
     def _bring_up(self, r: _Replica, now: float) -> bool:
@@ -384,18 +518,46 @@ class ReplicaSet:
 
         attempt = r.bringups
         r.bringups += 1
+        # per-replica weight resolution: a replica mid-upgrade carries
+        # an override (new params pytree, or a new ckpt path for the
+        # checkpoint-path attach shape); everyone else serves the
+        # set-level weights. weights_version rides into the engine so
+        # every Result it fulfils is stamped with the generation that
+        # decoded it — and the same string keys the prefix cache
+        # (model_version), so an upgraded replica can never serve a
+        # previous generation's cached prompt KV.
+        params = self.params if r.params_override is None \
+            else r.params_override
+        ckpt = self.worker_ckpt if r.ckpt_override is None \
+            else r.ckpt_override
+        versioned = dict(weights_version=r.version,
+                         model_version=r.version)
         try:
             faults.on_replica_bringup(r.index, attempt)
+            if r.born_scaled:
+                # the scale-out fault row: a replica born from
+                # add_replica killed mid-bring-up (circuit-breaks and
+                # retries; the serving survivors must be untouched)
+                faults.on_scale_add_bringup(r.index, attempt)
             if self.isolation == "process":
                 from dalle_pytorch_tpu.serve import ipc
+                if ckpt is not None:
+                    np_params = None
+                elif r.params_override is not None:
+                    import jax
+                    import numpy as np
+                    np_params = jax.tree.map(np.asarray,
+                                             r.params_override)
+                else:
+                    np_params = self._np_params
                 client = ipc.ChildEngineClient(
-                    self._np_params, self.cfg,
+                    np_params, self.cfg,
                     index=r.index,
-                    engine_kwargs=self._child_kwargs,
+                    engine_kwargs={**self._child_kwargs, **versioned},
                     device_index=r.index,
                     place=self._placed,
                     devices_per_replica=self.devices_per_replica,
-                    ckpt_path=self.worker_ckpt,
+                    ckpt_path=ckpt,
                     ckpt_use_ema=self.worker_use_ema,
                     ckpt_quantize=self.worker_quantize,
                     heartbeat_interval_s=min(
@@ -422,15 +584,17 @@ class ReplicaSet:
                     from dalle_pytorch_tpu.serve.mesh_engine import \
                         MeshEngine
                     engine = MeshEngine(
-                        self.params, self.cfg, queue,
-                        complete=self.complete, clock=self.clock,
-                        devices=r.device, **self._engine_kwargs)
+                        params, self.cfg, queue,
+                        complete=self._on_complete, clock=self.clock,
+                        devices=r.device,
+                        **{**self._engine_kwargs, **versioned})
                 else:
-                    engine = Engine(self.params, self.cfg, queue,
-                                    complete=self.complete,
+                    engine = Engine(params, self.cfg, queue,
+                                    complete=self._on_complete,
                                     clock=self.clock,
                                     device=r.device,
-                                    **self._engine_kwargs)
+                                    **{**self._engine_kwargs,
+                                       **versioned})
         except Exception as e:  # noqa: BLE001 — circuit-break, don't die
             r.attempt += 1
             self.bringup_failures += 1
@@ -476,8 +640,10 @@ class ReplicaSet:
         """Completion hand-off for process-mode results (the client's
         ``on_done``): same contract as ``Engine._finish`` — OK results
         flow downstream (postprocess), everything else fulfils the
-        handle directly."""
-        if result.status == S.OK and self.complete is not None:
+        handle directly. Canary probes (rolling upgrade) never flow
+        downstream: the health gate reads them, nobody else."""
+        if result.status == S.OK and self.complete is not None \
+                and not getattr(handle, "canary", False):
             self.complete(handle, result)
         else:
             handle.fulfill(result)
@@ -525,6 +691,13 @@ class ReplicaSet:
                 # handles a thread wedged inside the admission compile
                 # holds in step locals (engine._admitting)
                 inflight = eng.inflight_handles()
+                # the engine's head-of-line page reservation must not
+                # die with it: hand it back to the shared-queue level
+                # (the router routes the waiting request with its EXACT
+                # prefix-aware need, not the blind full-span guess)
+                hol = (None if eng.kv != "paged"
+                       or eng._hol_rid is None
+                       else (eng._hol_rid, eng._hol_need))
             finally:
                 if got:
                     eng._lock.release()
@@ -545,11 +718,24 @@ class ReplicaSet:
                 if h.done() or rid in seen:
                     continue
                 seen.add(rid)
+                if getattr(h, "canary", False):
+                    # an upgrade probe dying with its replica: cancel,
+                    # never replay — a canary in the shared queue would
+                    # decode as (and be billed like) real traffic
+                    h.fulfill(S.Result(
+                        status=S.CANCELLED, request_id=rid,
+                        reason="canary cancelled (replica fenced)"))
+                    continue
                 # original arrival position: zero-loss AND no
                 # queue-jumping — a replayed request neither loses
                 # its place nor steals anyone else's
                 self.queue.requeue(h)
                 reclaimed += 1
+            if hol is not None and hol[0] in seen:
+                self._hol_handoff[hol[0]] = hol[1]
+                self.hol_handoffs += 1
+                self._event("serve_hol_handoff", replica=r.index,
+                            request_id=hol[0], pages_needed=hol[1])
         self.reclaimed += reclaimed
         self._event("serve_replica_fenced", replica=r.index,
                     reason=reason, reclaimed=reclaimed)
@@ -579,11 +765,30 @@ class ReplicaSet:
             retire = client.retire_counters(handles)
             for k in _COUNTERS:
                 self._retired[k] += retire.get(k, 0)
+            rids = set()
             for h in handles:
+                rid = h.request.request_id
+                if getattr(h, "canary", False):
+                    # same rule as the thread path: probes die with
+                    # their replica, they never replay as traffic
+                    h.fulfill(S.Result(
+                        status=S.CANCELLED, request_id=rid,
+                        reason="canary cancelled (replica fenced)"))
+                    continue
+                rids.add(rid)
                 # original arrival position: zero-loss AND no
                 # queue-jumping, same as the thread path
                 self.queue.requeue(h)
                 reclaimed += 1
+            # the child's last-frame HOL reservation (serve/ipc.py
+            # snapshots mirror it) hands back exactly like a thread
+            # engine's — the corpse can't be asked, the mirror can
+            if client.hol is not None and client.hol[0] in rids:
+                self._hol_handoff[client.hol[0]] = client.hol[1]
+                self.hol_handoffs += 1
+                self._event("serve_hol_handoff", replica=r.index,
+                            request_id=client.hol[0],
+                            pages_needed=client.hol[1])
         self.reclaimed += reclaimed
         self._event("serve_replica_fenced", replica=r.index,
                     reason=reason, reclaimed=reclaimed,
@@ -605,7 +810,8 @@ class ReplicaSet:
         on the survivors, zero requests lost) and hold the replica DOWN
         until ``undrain_replica``. Returns the number reclaimed."""
         with self._ctl_lock:
-            r = self.replicas[index]
+            self._reject_mid_upgrade("drain")
+            r = self._replica_or_reject("drain", index)
             n = self._fence_and_reclaim(r, self.clock(), reason)
             r.state = DRAINED
             return n
@@ -614,10 +820,394 @@ class ReplicaSet:
         """Bring a drained replica back into routing (one bring-up
         attempt now; failure re-enters the circuit-breaker path)."""
         with self._ctl_lock:
+            self._reject_mid_upgrade("undrain")
             r = self.replicas[index]
             if r.state != DRAINED:
                 return False
             return self._bring_up(r, self.clock())
+
+    # -- elastic fleet: runtime scale-out/in --------------------------------
+
+    def _replica_or_reject(self, op: str, index: int) -> _Replica:
+        """The slot an operator named, or a typed ``ScaleError`` — a
+        retired tombstone or an out-of-range index must never be acted
+        on half-way."""
+        if not 0 <= index < len(self.replicas):
+            raise ScaleError(S.structured_event(
+                "serve_scale_reject", op=op, replica=index,
+                reason="no_such_replica",
+                replicas=len(self.replicas)))
+        r = self.replicas[index]
+        if r.state == RETIRED:
+            raise ScaleError(S.structured_event(
+                "serve_scale_reject", op=op, replica=index,
+                reason="replica_retired"))
+        return r
+
+    def _reject_mid_upgrade(self, op: str) -> None:
+        if self._upgrading:
+            raise ScaleError(S.structured_event(
+                "serve_scale_reject", op=op,
+                reason="upgrade_in_progress"))
+
+    def add_replica(self) -> int:
+        """Runtime scale-out: append one new supervised slot — same
+        isolation/transport/mesh shape as the rest of the set — and
+        bring it up now. The replica joins routing ATOMICALLY once
+        serving (thread engines immediately; process children at their
+        READY frame — ``_route`` never offers work to a slot that
+        cannot take it), and a bring-up failure circuit-breaks with
+        backoff exactly like a failover restart: the survivors never
+        notice. Growing past ``max_replicas`` is a typed ``ScaleError``
+        — the cap exists because every replica allocates its own KV
+        page pool, so fleet width is an HBM page budget, not a free
+        integer. Returns the new replica's index."""
+        with self._ctl_lock:
+            self._reject_mid_upgrade("add")
+            active = [r for r in self.replicas if r.state != RETIRED]
+            if self.max_replicas and len(active) >= self.max_replicas:
+                raise ScaleError(S.structured_event(
+                    "serve_scale_reject", op="add",
+                    reason="scale_out_past_cap",
+                    replicas=len(active),
+                    max_replicas=self.max_replicas))
+            index = len(self.replicas)
+            r = _Replica(index, device=self._device_for(index),
+                         version=self.weights_version)
+            r.born_scaled = True
+            self.replicas.append(r)
+            self.n_replicas = len(active) + 1
+            self.scale_outs += 1
+            self._event("serve_scale_out", replica=index,
+                        replicas=self.n_replicas,
+                        weights_version=self.weights_version)
+            self._bring_up(r, self.clock())
+            return index
+
+    def remove_replica(self, index: int, drain: bool = True,
+                       reason: str = "operator scale-in") -> int:
+        """Runtime scale-in: drain ``index``'s in-flight work to the
+        survivors (the same fence→reclaim→replay as failover — the
+        reclaim is unconditional, zero-loss is not a flag; ``drain``
+        names the operator's intent in the event stream) and RETIRE
+        the slot for good. Removing the last live replica is a typed
+        ``ScaleError``: a set with no slots is not a smaller fleet, it
+        is an outage an operator almost certainly didn't mean. Returns
+        the number of requests reclaimed to survivors."""
+        with self._ctl_lock:
+            self._reject_mid_upgrade("remove")
+            r = self._replica_or_reject("remove", index)
+            survivors = [x for x in self.replicas
+                         if x is not r and x.state != RETIRED]
+            if not survivors:
+                raise ScaleError(S.structured_event(
+                    "serve_scale_reject", op="remove", replica=index,
+                    reason="remove_last_replica"))
+            n = self._fence_and_reclaim(r, self.clock(), reason)
+            r.state = RETIRED
+            r.params_override = None
+            r.ckpt_override = None
+            self.n_replicas = len(survivors)
+            self.scale_ins += 1
+            self._event("serve_scale_in", replica=index, drain=drain,
+                        reclaimed=n, replicas=self.n_replicas)
+            return n
+
+    # -- elastic fleet: rolling weight hot-swap -----------------------------
+
+    def _drive_until(self, pred: Callable[[], bool],
+                     timeout_s: float) -> bool:
+        """Wait for ``pred`` while keeping the set moving: in threaded
+        mode the control loop is already running, so just sleep; in
+        single-threaded drive (tests, bench) the caller IS the loop,
+        so step. Wall-clock bounded either way."""
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            if pred():
+                return True
+            if self._started:
+                time.sleep(0.005)
+            else:
+                self.step_once()
+        return pred()
+
+    def _replica_serving(self, r: _Replica) -> bool:
+        """The replica's engine can decode a request RIGHT NOW (for a
+        process child: READY landed and the process is believable)."""
+        if r.state != RUNNING or r.engine is None:
+            return False
+        if self.isolation == "process":
+            c = r.engine
+            return c.ready and not c.crashed and not c.poisoned \
+                and not c.fenced and c.alive_proc()
+        return True
+
+    def _submit_canaries(self, r: _Replica, version: str,
+                         canary_codes, n: int) -> List[S.RequestHandle]:
+        """Hand ``n`` canary requests DIRECTLY to replica ``r`` —
+        never through the shared queue, where a survivor would answer
+        them and the gate would prove nothing. Canary ids are negative
+        (they can never collide with queue-assigned request ids) and
+        the handles are marked so reclaim cancels rather than replays
+        them and completions bypass the postprocess stage."""
+        now = self.clock()
+        handles = []
+        for k in range(n):
+            codes = tuple(canary_codes[k % len(canary_codes)])
+            rid = next(self._canary_ids)
+            req = S.Request(codes=codes, seed=10_000 + k,
+                            request_id=rid, submit_t=now)
+            h = S.RequestHandle(req)
+            h.queue_seq = rid       # unique (negative), heap-safe
+            h.canary = True
+            h.replay_version = version
+            handles.append(h)
+        with self._ctl_lock:
+            if self.isolation == "process":
+                r.engine.route(handles)
+            else:
+                for h in handles:
+                    r.queue.requeue(h, count=False)
+        return handles
+
+    def _abort_upgrade(self, r: _Replica, version: str,
+                       old_version: str, error: str,
+                       timeout_s: float) -> None:
+        """Roll the WHOLE fleet back to the old weights and raise the
+        typed ``UpgradeAborted`` — the failing replica ``r`` AND every
+        replica upgraded earlier in this cycle re-cycle (drain →
+        bring-up on the old weights), so the abort leaves the fleet
+        fully serving ``old_version``, never a mixed-version state.
+        Work reclaimed from a rolled-back replica was pinned to the NEW
+        generation; once no replica of it remains, the router releases
+        the pin (structured event) and the replay re-decodes on the old
+        weights — zero requests lost either way."""
+        self._event("serve_upgrade_abort", replica=r.index, to=version,
+                    error=error)
+        rollback = [x for x in self.replicas
+                    if x.state != RETIRED and x.version == version]
+        for x in rollback:
+            with self._ctl_lock:
+                self._fence_and_reclaim(x, self.clock(),
+                                        reason="upgrade rollback")
+                x.canary = False
+                x.version = old_version
+                x.params_override = None
+                x.ckpt_override = None
+                self._bring_up(x, self.clock())
+            # bounded wait for the rollback engine; a replica that
+            # cannot even serve the OLD weights re-enters the circuit
+            # breaker, which is the failover path's problem, not the
+            # upgrade's
+            self._drive_until(lambda x=x: self._replica_serving(x),
+                              timeout_s)
+        # the aborted generation's canary references must not outlive
+        # the abort: a RETRY of the same version name compares its
+        # replica-0 canaries against a fresh reference, not the failed
+        # attempt's tokens (which may have come from a bad checkpoint)
+        for k in [k for k in self._canary_ref if k[0] == version]:
+            del self._canary_ref[k]
+        raise UpgradeAborted(S.structured_event(
+            "serve_upgrade_aborted", replica=r.index, to=version,
+            error=error, rolled_back=[x.index for x in rollback],
+            fleet_version=old_version))
+
+    def rolling_upgrade(self, *, version: str, params=None,
+                        ckpt: Optional[str] = None,
+                        canary_codes=None, canaries: int = 2,
+                        replica_timeout_s: float = 300.0) -> dict:
+        """Hot-swap the fleet's weights replica-by-replica with ZERO
+        dropped requests (docs/SERVING.md 'Elastic fleet'). Per
+        replica, in index order:
+
+          1. DRAIN — fence + reclaim: its in-flight work replays on
+             survivors still serving the OLD weights (version-pinned
+             routing guarantees the replay lands on the generation
+             that started it, so the tokens stay byte-identical);
+          2. RESTART on the new weights — ``params`` (a new pytree,
+             thread/pipe shapes) or ``ckpt`` (a new ``--worker_ckpt``
+             path for checkpoint-path attach: each worker loads +
+             validates locally, weights never cross the wire);
+          3. HEALTH-GATE — ``canaries`` requests decoded by the new
+             engine ALONE, token-compared against the first upgraded
+             replica's canary tokens (every replica of a generation
+             must provably sample identical streams; replica 0 of the
+             cycle sets the reference). A canary error, token
+             divergence, bring-up failure/timeout, or the replica
+             dying mid-canary ABORTS: the replica rolls back to the
+             old weights and the typed ``UpgradeAborted`` reports the
+             fleet whole on the old version;
+          4. UNDRAIN — the gated replica rejoins routing; next slot.
+
+        After the last replica, the set-level weights/version are
+        promoted so future bring-ups, scale-outs, and /stats all speak
+        the new generation. Returns the structured upgrade record."""
+        import numpy as np
+
+        from dalle_pytorch_tpu.resilience import faults
+
+        with self._ctl_lock:
+            self._reject_mid_upgrade("upgrade")
+            if not version or version == self.weights_version:
+                raise ScaleError(S.structured_event(
+                    "serve_scale_reject", op="upgrade",
+                    reason="version_unchanged",
+                    weights_version=self.weights_version))
+            if (params is None) == (ckpt is None):
+                raise ScaleError(S.structured_event(
+                    "serve_scale_reject", op="upgrade",
+                    reason="need_exactly_one_of_params_or_ckpt"))
+            if ckpt is not None and self.worker_ckpt is None:
+                raise ScaleError(S.structured_event(
+                    "serve_scale_reject", op="upgrade",
+                    reason="ckpt_upgrade_needs_worker_ckpt_set"))
+            if params is not None and self.worker_ckpt is not None:
+                raise ScaleError(S.structured_event(
+                    "serve_scale_reject", op="upgrade",
+                    reason="params_upgrade_on_worker_ckpt_set"))
+            self._upgrading = True
+        # EVERYTHING past the flag runs under the finally that clears
+        # it — an exception anywhere here (even a bad canaries value)
+        # must never leave the fleet permanently rejecting reshapes
+        try:
+            old_version = self.weights_version
+            if canary_codes is None:
+                # smallest-bucket probe; any valid prompt does — the
+                # gate compares determinism across replicas, not
+                # quality
+                canary_codes = [(1,) * min(2, self.cfg.text_seq_len)]
+            record = {"from": old_version, "to": version,
+                      "canaries": int(canaries), "replicas": []}
+            self._event("serve_upgrade_begin", to=version,
+                        from_version=old_version,
+                        replicas=self.n_replicas)
+            for r in list(self.replicas):
+                if r.state == RETIRED:
+                    continue
+                if r.state == DRAINED:
+                    # an operator-drained replica stays DOWN — the
+                    # drain contract ('down until undrain_replica')
+                    # outranks the rollout. Its version label moves
+                    # with the fleet at promote time, so a later
+                    # undrain brings it up on the promoted set-level
+                    # weights, correctly stamped; the skip is an event
+                    # an operator can see, not a silent hole.
+                    self._event("serve_upgrade_skip_drained",
+                                replica=r.index, to=version)
+                    record["replicas"].append(
+                        {"replica": r.index, "skipped": "drained"})
+                    continue
+                t0 = time.perf_counter()
+                # the drain-race fault row: a real SIGKILL landing just
+                # as the planned drain begins — reclaim-from-shadow
+                # absorbs it identically (the fence kills a corpse)
+                faults.on_upgrade_drain(
+                    r.index,
+                    getattr(r.engine, "pid", None)
+                    if self.isolation == "process" else None)
+                with self._ctl_lock:
+                    reclaimed = self._fence_and_reclaim(
+                        r, self.clock(),
+                        reason=f"rolling upgrade to {version}")
+                    r.version = version
+                    r.params_override = params
+                    r.ckpt_override = ckpt
+                    r.canary = True
+                    self._bring_up(r, self.clock())
+                if not self._drive_until(
+                        lambda: self._replica_serving(r),
+                        replica_timeout_s):
+                    self._abort_upgrade(
+                        r, version, old_version,
+                        f"bring-up on new weights timed out "
+                        f"(> {replica_timeout_s:g}s): {r.last_error}",
+                        replica_timeout_s)
+                # captured AFTER serving is confirmed: a circuit-breaker
+                # retry DURING bring-up (flaky first spawn) is the
+                # supervisor doing its job, not a death — only a
+                # bring-up count moving while canaries are in flight
+                # means the fresh engine died under the gate
+                bringups0 = r.bringups
+                handles = self._submit_canaries(r, version,
+                                                canary_codes, canaries)
+                self._drive_until(
+                    lambda: all(h.done() for h in handles)
+                    or r.bringups != bringups0
+                    or not self._replica_serving(r),
+                    replica_timeout_s)
+                if r.bringups != bringups0 \
+                        or not self._replica_serving(r):
+                    self._abort_upgrade(
+                        r, version, old_version,
+                        f"replica died during canary: {r.last_error}",
+                        replica_timeout_s)
+                if not all(h.done() for h in handles):
+                    self._abort_upgrade(
+                        r, version, old_version,
+                        f"canaries not answered within "
+                        f"{replica_timeout_s:g}s", replica_timeout_s)
+                try:
+                    for k, h in enumerate(handles):
+                        res = h.result(timeout=0)
+                        if res.status != S.OK:
+                            raise RuntimeError(
+                                f"canary {k}: {res.status} "
+                                f"({res.reason})")
+                        if res.weights_version != version:
+                            raise RuntimeError(
+                                f"canary {k} stamped "
+                                f"{res.weights_version!r}, expected "
+                                f"{version!r}")
+                        toks = np.asarray(res.tokens)
+                        ref = self._canary_ref.setdefault(
+                            (version, k), toks)
+                        if not np.array_equal(toks, ref):
+                            raise RuntimeError(
+                                f"canary {k} tokens diverged from the "
+                                f"generation reference — two replicas "
+                                f"of {version!r} must sample "
+                                f"byte-identical streams")
+                    faults.on_canary_gate(r.index, version)
+                except Exception as e:  # noqa: BLE001 — typed abort
+                    self._abort_upgrade(r, version, old_version,
+                                        f"canary gate failed: {e}",
+                                        replica_timeout_s)
+                r.canary = False
+                self._event("serve_upgrade_replica", replica=r.index,
+                            to=version, reclaimed=reclaimed,
+                            canaries=len(handles),
+                            wall_s=round(time.perf_counter() - t0, 3))
+                record["replicas"].append({
+                    "replica": r.index, "reclaimed": reclaimed,
+                    "wall_s": round(time.perf_counter() - t0, 3)})
+            with self._ctl_lock:
+                # promote: the new generation is now the set's truth —
+                # future bring-ups, scale-outs, and stats all speak it
+                self.weights_version = version
+                if params is not None:
+                    self.params = params
+                    if self.isolation == "process" \
+                            and self.worker_ckpt is None:
+                        import jax
+                        self._np_params = jax.tree.map(np.asarray,
+                                                       params)
+                if ckpt is not None:
+                    self.worker_ckpt = ckpt
+                for r in self.replicas:
+                    r.params_override = None
+                    r.ckpt_override = None
+                    if r.state == DRAINED:
+                        # skipped above; its next bring-up serves the
+                        # promoted set-level weights, so the label
+                        # must say so
+                        r.version = version
+                self.upgrades += 1
+            self._event("serve_upgrade_done", to=version,
+                        from_version=old_version,
+                        replicas=len(record["replicas"]))
+            return record
+        finally:
+            self._upgrading = False
 
     # -- supervision --------------------------------------------------------
 
@@ -754,13 +1344,27 @@ class ReplicaSet:
                 continue
             did = c.pump() or did
             if r.await_ready and c.ready:
+                announced = c.worker_weights_version
+                if announced and announced != r.version:
+                    # a worker serving the WRONG generation must never
+                    # join routing: during a rolling upgrade a stale
+                    # dialer (or an operator pointing an old worker at
+                    # a reshaped fleet) would silently decode on old
+                    # weights — fence it as a bring-up failure instead
+                    self._bringup_fail_async(
+                        r, now,
+                        f"worker announced weights {announced!r}, "
+                        f"replica expects {r.version!r}")
+                    did = True
+                    continue
                 r.await_ready = False
                 r.attempt = 0
                 r.last_error = ""
                 r.conns += 1
                 self._event("serve_replica_up", replica=r.index,
                             bringups=r.bringups, pid=c.pid,
-                            transport=c.transport_kind, peer=c.peer)
+                            transport=c.transport_kind, peer=c.peer,
+                            weights_version=r.version)
                 did = True
         return did
 
@@ -769,12 +1373,15 @@ class ReplicaSet:
     def _expire(self, h: S.RequestHandle, now: float) -> None:
         req = h.request
         self.expired += 1
+        self._hol_handoff.pop(req.request_id, None)
+        self._version_holds.discard(req.request_id)
         self._event("serve_deadline", request_id=req.request_id,
                     where="queued", deadline_s=req.deadline_s,
                     waited_s=round(now - req.submit_t, 4))
         h.fulfill(S.Result(
             status=S.DEADLINE_EXCEEDED, request_id=req.request_id,
             reason=f"deadline_s={req.deadline_s:g} exceeded (queued)",
+            weights_version=self.weights_version,
             queued_s=round(now - req.submit_t, 6),
             total_s=round(now - req.submit_t, 6)))
 
@@ -796,7 +1403,22 @@ class ReplicaSet:
         free pages break remaining ties."""
         from dalle_pytorch_tpu.serve import kv_pool as KV
 
+        pin = h.replay_version
+        # a fenced/drained replica's HOL reservation, handed back at
+        # reclaim: the EXACT (prefix-aware) page need, which beats the
+        # blind full-span guess below — the retiring replica's claim
+        # follows the request instead of dying with the engine
+        handoff = self._hol_handoff.get(h.request.request_id)
+
         def score(r: _Replica):
+            if pin is not None and r.version != pin:
+                # the route-level candidate filter makes this
+                # unreachable; decoding a pinned replay on another
+                # generation's weights must be impossible, not unlikely
+                raise ReplayVersionMismatch(S.structured_event(
+                    "serve_replay_version_mismatch",
+                    request_id=h.request.request_id, pinned=pin,
+                    replica=r.index, version=r.version))
             eng = r.engine
             fits, free_pages = True, 0
             if eng.kv == "paged":
@@ -812,9 +1434,10 @@ class ReplicaSet:
                     free_pages = eng.alloc.free
                     buckets, page_size = eng.buckets, eng.page_size
                 try:
-                    need = KV.pages_for(
-                        S.bucket_for(len(h.request.codes), buckets),
-                        page_size)
+                    need = handoff if handoff is not None \
+                        else KV.pages_for(
+                            S.bucket_for(len(h.request.codes), buckets),
+                            page_size)
                     fits = free_pages >= need
                 except ValueError:
                     # an over-long prompt buckets nowhere; the engine's
@@ -831,7 +1454,10 @@ class ReplicaSet:
         deadline expiries are reaped here on EVERY sweep — even with
         zero live replicas, a dead entry must get its typed result."""
         live = [r for r in self.replicas
-                if r.state == RUNNING and r.engine is not None]
+                if r.state == RUNNING and r.engine is not None
+                and not r.canary]
+        # (canary replicas are serving, but only the upgrade's health
+        # gate may talk to them — routing rejoins at gate pass)
         if self.isolation == "process":
             # routable = READY and believable: not poisoned/crashed and
             # the PID is live RIGHT NOW — never route into a corpse in
@@ -847,8 +1473,21 @@ class ReplicaSet:
             self._expire(h, now)
         assigned: dict = {}
         for h in ready:
-            cands = [r for r in live if caps[r.index] > 0]
+            pin = h.replay_version
+            cands = [r for r in live if caps[r.index] > 0
+                     and (pin is None or r.version == pin)]
+            if not cands:
+                # version-pinned replay with no same-generation
+                # capacity right now: hold or release, never mis-route
+                self._route_hold(h, pin)
+                continue
             r = self._pick(cands, caps, h)
+            if pin is None:
+                # pin at first routing: from here on, failover replay
+                # of this request goes only to this weights generation
+                h.replay_version = r.version
+            self._hol_handoff.pop(h.request.request_id, None)
+            self._version_holds.discard(h.request.request_id)
             caps[r.index] -= 1
             if self.isolation == "process":
                 assigned.setdefault(r.index, (r, []))[1].append(h)
@@ -857,6 +1496,33 @@ class ReplicaSet:
         for r, batch in assigned.values():
             r.engine.route(batch)       # one admit frame per replica
         return bool(ready or expired)
+
+    def _route_hold(self, h: S.RequestHandle,
+                    pin: Optional[str]) -> None:
+        """A popped request the router cannot place THIS sweep. A
+        version-pinned replay whose generation still exists somewhere
+        in the fleet (busy, circuit-broken, draining — it may come
+        back) is HELD at its original arrival position; one whose
+        generation has left the fleet entirely (the upgrade completed
+        under it) has its pin RELEASED — zero-loss outranks a stale
+        pin, the request re-decodes from scratch on the current
+        weights, and its Result is stamped with the version that
+        actually produced the tokens. Both paths are structured
+        events, fired once per request."""
+        rid = h.request.request_id
+        if pin is not None and not any(
+                rr.version == pin and rr.state != RETIRED
+                for rr in self.replicas):
+            h.replay_version = None
+            self._version_holds.discard(rid)
+            self._event("serve_replay_version_released",
+                        request_id=rid, pinned=pin,
+                        fleet_version=self.weights_version)
+        elif rid not in self._version_holds:
+            self._version_holds.add(rid)
+            self._event("serve_replay_version_hold", request_id=rid,
+                        pinned=pin)
+        self.queue.requeue(h, count=False)
 
     # -- the replica loop (threaded mode) -----------------------------------
 
@@ -1110,7 +1776,10 @@ class ReplicaSet:
                 alive = r.state == RUNNING and r.engine is not None and \
                     (r.thread is None or r.thread.is_alive())
             rec = {"replica": r.index, "state": r.state, "alive": alive,
-                   "bringups": r.bringups}
+                   "bringups": r.bringups,
+                   "weights_version": r.version}
+            if r.canary:
+                rec["canary"] = True    # upgrading: gate-only, unrouted
             if r.engine is not None:
                 rec["heartbeat_age_s"] = round(
                     max(now - r.engine.last_heartbeat, 0.0), 4)
@@ -1174,7 +1843,8 @@ class ReplicaSet:
         proc = self.isolation == "process"
         per = []
         for r in self.replicas:
-            rec = {"replica": r.index, "state": r.state}
+            rec = {"replica": r.index, "state": r.state,
+                   "weights_version": r.version}
             if r.engine is not None:
                 e = r.engine
                 rec.update({
@@ -1244,13 +1914,25 @@ class ReplicaSet:
             "reclaimed": self.reclaimed,
             "bringup_failures": self.bringup_failures,
             "evicted": self._agg("evicted"),
+            # the elastic surface: current generation, reshape
+            # counters, and whether a rolling upgrade owns the fleet
+            "weights_version": self.weights_version,
+            "max_replicas": self.max_replicas,
+            "scale_outs": self.scale_outs,
+            "scale_ins": self.scale_ins,
+            "upgrades": self.upgrades,
+            "upgrading": self._upgrading,
+            "hol_handoffs": self.hol_handoffs,
             "per_replica": per,
         }
         if proc:
             out["transport"] = self.transport
             if self.listener is not None:
-                # where a remote worker dials in, and how many dialers
-                # the HELLO gate turned away
+                # where a remote worker dials in, how many dialers the
+                # HELLO gate turned away, and which replica indices are
+                # currently open for attach (runtime-born slots
+                # included — the registry is never startup-static)
                 out["worker_endpoint"] = self.listener.endpoint
                 out["attach_rejected"] = self.listener.rejected
+                out["attach_expected"] = self.listener.expected_indices()
         return out

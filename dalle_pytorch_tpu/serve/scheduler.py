@@ -203,7 +203,11 @@ class Result:
     plus the model-sampled text tokens filling it out to ``text_seq_len``
     — ``generate_images``'s ``full[:, :text_seq_len]``), what CLIP
     rerank scores; ``image`` is filled by the postprocess stage when
-    image decoding is enabled."""
+    image decoding is enabled. ``weights_version`` names the weight
+    generation that produced the tokens (stamped by the engine that
+    decoded them) — the rolling-upgrade contract is that same-seed
+    tokens are byte-identical PER weights_version, so a caller or a
+    replay audit can always tell which generation a result came from."""
     status: str
     request_id: int
     tokens: object = None
@@ -211,6 +215,7 @@ class Result:
     image: object = None
     clip_score: Optional[float] = None
     reason: str = ""
+    weights_version: str = ""
     queued_s: float = 0.0
     decode_s: float = 0.0
     total_s: float = 0.0
@@ -232,6 +237,7 @@ class Result:
             "text_tokens": (None if self.text_tokens is None
                             else [int(t) for t in self.text_tokens]),
             "reason": str(self.reason),
+            "weights_version": str(self.weights_version),
             "queued_s": float(self.queued_s),
             "decode_s": float(self.decode_s),
             "total_s": float(self.total_s),
@@ -253,6 +259,9 @@ class Result:
             text_tokens=None if text is None else np.asarray(
                 [int(t) for t in text], np.int32),
             reason=str(d["reason"]),
+            # .get: frames from a pre-upgrade peer decode as unversioned
+            # instead of failing the attach (Request.from_wire's rule)
+            weights_version=str(d.get("weights_version", "")),
             queued_s=float(d["queued_s"]),
             decode_s=float(d["decode_s"]),
             total_s=float(d["total_s"]))
@@ -281,6 +290,15 @@ class RequestHandle:
         # large-prompt request deferred on pages would re-enter behind a
         # steady stream of small requests and could starve forever
         self.queue_seq: int = -1
+        # the weights generation this request first routed to (set by
+        # the replica-set router, parent-side only — it never crosses
+        # the wire because reclaim always reads the parent's handle).
+        # While pinned, failover replay routes ONLY to a replica on the
+        # same version: replayed tokens must be byte-identical to the
+        # undisturbed run, and a newer generation's logits are not.
+        # None = unpinned (fresh request, or pin released because the
+        # version left the fleet entirely — see replica._route).
+        self.replay_version: Optional[str] = None
 
     def done(self) -> bool:
         return self._done.is_set()

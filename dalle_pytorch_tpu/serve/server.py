@@ -68,6 +68,11 @@ class InferenceServer:
                  default_cfg_scale: float = 0.0,
                  replicas: int = 1,
                  mesh_devices: int = 1,
+                 weights_version: str = "0",
+                 max_replicas: int = 0,
+                 autoscale=None,
+                 admin_token: Optional[str] = None,
+                 load_weights: Optional[Callable] = None,
                  heartbeat_s: float = 5.0,
                  isolation: str = "thread",
                  child_rss_limit_mb: int = 0,
@@ -95,6 +100,28 @@ class InferenceServer:
         self.init_deadline_s = init_deadline_s
         self.init_retries = init_retries
         self.replicas = int(replicas)
+        # the elastic operator surface (docs/SERVING.md 'Elastic
+        # fleet'): POST /admin/scale authenticates against this token
+        # (generated when the caller supplies none — printed by the
+        # CLI, never guessable), add/remove/drain/upgrade delegate to
+        # the replica set, and an AutoscalePolicy drives the same
+        # calls off the load signals. A single-replica server with
+        # autoscale or a max_replicas headroom cap still fronts a
+        # ReplicaSet — elasticity needs supervised slots to grow into.
+        import secrets as _secrets
+        self.admin_token = admin_token or _secrets.token_hex(16)
+        self.autoscale_policy = autoscale
+        self.autoscaler = None
+        self.load_weights = load_weights
+        self.weights_version = str(weights_version)
+        self.max_replicas = int(max_replicas)
+        self._is_set = (self.replicas > 1 or autoscale is not None
+                        or self.max_replicas > 1)
+        if autoscale is not None:
+            # the policy caps and the set cap must agree, or the
+            # autoscaler would ask for replicas the set typed-rejects
+            self.max_replicas = max(self.max_replicas,
+                                    autoscale.max_replicas)
         self.mesh_devices = int(mesh_devices)
         if self.mesh_devices < 1:
             raise ValueError(f"mesh_devices must be >= 1, got "
@@ -142,7 +169,7 @@ class InferenceServer:
                 params, vae_params, cfg, clip_params=clip_params,
                 clip_cfg=clip_cfg, metrics=metrics,
                 on_fulfill=self._record_latency)
-        if self.replicas > 1:
+        if self._is_set:
             from dalle_pytorch_tpu.serve import replica as replica_mod
             self.engine = replica_mod.ReplicaSet(
                 params, cfg, self.queue, replicas=self.replicas,
@@ -160,7 +187,13 @@ class InferenceServer:
                 worker_ckpt=worker_ckpt,
                 worker_use_ema=worker_use_ema,
                 worker_quantize=worker_quantize,
-                devices_per_replica=self.mesh_devices)
+                devices_per_replica=self.mesh_devices,
+                weights_version=self.weights_version,
+                max_replicas=self.max_replicas)
+            if self.autoscale_policy is not None:
+                from dalle_pytorch_tpu.serve.autoscale import Autoscaler
+                self.autoscaler = Autoscaler(
+                    self.engine, self.autoscale_policy, metrics=metrics)
         elif self.mesh_devices > 1:
             # ONE logical engine pjit-sharded over a device mesh — the
             # serve surface is identical (docs/SERVING.md 'Mesh-sharded
@@ -180,7 +213,9 @@ class InferenceServer:
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
                 paged_attn=paged_attn, sparse_reads=sparse_reads,
-                prefix_cache=prefix_cache)
+                prefix_cache=prefix_cache,
+                weights_version=self.weights_version,
+                model_version=self.weights_version)
         else:
             self.engine = engine_mod.Engine(
                 params, cfg, self.queue, num_slots=num_slots,
@@ -189,7 +224,9 @@ class InferenceServer:
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
                 paged_attn=paged_attn, sparse_reads=sparse_reads,
-                prefix_cache=prefix_cache)
+                prefix_cache=prefix_cache,
+                weights_version=self.weights_version,
+                model_version=self.weights_version)
 
         # bounded window: p50/p95 over the last 10k completions — an
         # unbounded list would grow (and re-sort under the lock) forever
@@ -248,8 +285,10 @@ class InferenceServer:
 
         if self.post is not None:
             self.post.start()
-        if self.replicas > 1:
+        if self._is_set:
             self.engine.start()     # per-replica threads + supervisor
+            if self.autoscaler is not None:
+                self.autoscaler.start()
         else:
             self._thread = threading.Thread(
                 target=self.engine.run, args=(self._stop,), daemon=True,
@@ -272,7 +311,9 @@ class InferenceServer:
         instead of stranding its caller."""
         self.queue.close()
         self._stop.set()
-        if self.replicas > 1:
+        if self.autoscaler is not None:
+            self.autoscaler.close()     # no reshapes during teardown
+        if self._is_set:
             self.engine.close(timeout)
         elif self._thread is not None:
             self._thread.join(timeout)
@@ -284,7 +325,7 @@ class InferenceServer:
         # after the engine thread stopped: slots still holding requests
         # would otherwise leave their callers blocked in result()
         # (the replica path cancelled its in-slot handles in close())
-        if self.replicas == 1:
+        if not self._is_set:
             self.engine.cancel_active("server shutdown")
         if self.post is not None:
             self.post.close(timeout)
@@ -322,7 +363,7 @@ class InferenceServer:
         """True while the serving loop is live (or before start). For a
         replica set: at least ONE replica serving — the set degrades,
         it does not die with a survivor standing."""
-        if self.replicas > 1:
+        if self._is_set:
             return self.engine.alive()
         return self._thread is None or self._thread.is_alive()
 
@@ -338,9 +379,89 @@ class InferenceServer:
                "devices_per_replica": self.mesh_devices,
                "mesh_shape": ({SERVE_AXIS: self.mesh_devices}
                               if self.mesh_devices > 1 else None)}
-        if self.replicas > 1:
+        if self._is_set:
             out["replicas"] = self.engine.replica_states()
+            out["weights_version"] = self.engine.weights_version
+            out["upgrading"] = self.engine._upgrading
         return out
+
+    # -- the operator scale surface (POST /admin/scale) ---------------------
+
+    def scale(self, op: str, **kwargs) -> dict:
+        """One operator reshape: ``add`` / ``remove`` / ``drain`` /
+        ``undrain`` / ``upgrade`` / ``status``, delegated to the
+        replica set's elastic API. Raises the set's typed errors
+        (``ScaleError`` for illegal transitions, ``UpgradeAborted``
+        for a failed rollout) — the HTTP facade maps them to status
+        codes, Python callers catch them directly."""
+        from dalle_pytorch_tpu.serve import replica as R
+        from dalle_pytorch_tpu.utils.metrics import structured_event
+        if not self._is_set:
+            raise R.ScaleError(structured_event(
+                "serve_scale_reject", op=op,
+                reason="not_a_replica_set"))
+        rs = self.engine
+        if op == "add":
+            index = rs.add_replica()
+            return {"op": op, "replica": index,
+                    "replicas": rs.n_replicas}
+        if op == "remove":
+            index = int(kwargs["replica"])
+            n = rs.remove_replica(index,
+                                  drain=bool(kwargs.get("drain", True)))
+            return {"op": op, "replica": index, "reclaimed": n,
+                    "replicas": rs.n_replicas}
+        if op == "drain":
+            index = int(kwargs["replica"])
+            return {"op": op, "replica": index,
+                    "reclaimed": rs.drain_replica(index)}
+        if op == "undrain":
+            index = int(kwargs["replica"])
+            return {"op": op, "replica": index,
+                    "ok": rs.undrain_replica(index)}
+        if op == "upgrade":
+            ckpt = kwargs.get("ckpt")
+            version = kwargs.get("version") or str(ckpt)
+            if ckpt is None:
+                raise R.ScaleError(structured_event(
+                    "serve_scale_reject", op=op,
+                    reason="upgrade_needs_ckpt"))
+            up = dict(version=str(version),
+                      canaries=int(kwargs.get("canaries", 2)))
+            if rs.worker_ckpt is not None:
+                # checkpoint-path attach: the PATH is the upgrade —
+                # each worker loads + validates it locally
+                up["ckpt"] = str(ckpt)
+            else:
+                if self.load_weights is None:
+                    raise R.ScaleError(structured_event(
+                        "serve_scale_reject", op=op,
+                        reason="no_weight_loader",
+                        detail="server built without load_weights; "
+                               "pass params via the Python API"))
+                try:
+                    up["params"] = self.load_weights(str(ckpt))
+                except Exception as e:  # noqa: BLE001 — a wrong or
+                    # torn checkpoint path is the MOST likely operator
+                    # mistake; it must answer as a typed refusal (the
+                    # fleet untouched), never escape the HTTP handler
+                    raise R.ScaleError(structured_event(
+                        "serve_scale_reject", op=op,
+                        reason="weight_load_failed", ckpt=str(ckpt),
+                        error=repr(e))) from e
+            record = rs.rolling_upgrade(**up)
+            self.weights_version = rs.weights_version
+            return {"op": op, **record}
+        if op == "status":
+            return {"op": op, "replicas": rs.replica_states(),
+                    "weights_version": rs.weights_version,
+                    "upgrading": rs._upgrading,
+                    "max_replicas": rs.max_replicas,
+                    "scale_outs": rs.scale_outs,
+                    "scale_ins": rs.scale_ins,
+                    "upgrades": rs.upgrades}
+        raise R.ScaleError(structured_event(
+            "serve_scale_reject", op=op, reason="unknown_op"))
 
     def stats(self) -> dict:
         with self._lat_lock:
@@ -368,6 +489,11 @@ def _result_body(result: S.Result) -> dict:
     body = {"status": result.status, "request_id": result.request_id,
             "reason": result.reason, "queued_s": result.queued_s,
             "decode_s": result.decode_s, "total_s": result.total_s}
+    if result.weights_version:
+        # which weight generation decoded these tokens — the rolling-
+        # upgrade contract's caller-visible half (byte-identical per
+        # version), so an HTTP client can audit a mid-upgrade mix
+        body["weights_version"] = result.weights_version
     if result.tokens is not None:
         body["tokens"] = [int(t) for t in result.tokens]
     if result.image is not None:
@@ -411,7 +537,45 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
+        def _admin_scale(self):
+            """POST /admin/scale — the authenticated operator reshape
+            endpoint (docs/SERVING.md 'Elastic fleet'): {"op": "add" |
+            "remove" | "drain" | "undrain" | "upgrade" | "status",
+            ...}. 401 without the admin token (Bearer or
+            X-Admin-Token), 409 with the structured record for a typed
+            ScaleError/UpgradeAborted — an illegal transition is a
+            refusal the operator can read, never a partial state."""
+            import hmac as _hmac
+
+            from dalle_pytorch_tpu.serve import replica as R
+            auth = self.headers.get("Authorization", "")
+            token = auth[7:] if auth.startswith("Bearer ") \
+                else (self.headers.get("X-Admin-Token") or "")
+            if not _hmac.compare_digest(token, server.admin_token):
+                self._send(401, {"error": "bad admin token"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(req, dict):
+                    raise ValueError(f"body must be a JSON object, "
+                                     f"got {type(req).__name__}")
+                op = str(req.pop("op"))
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": f"need a JSON body with "
+                                          f"'op': {e}"})
+                return
+            try:
+                self._send(200, server.scale(op, **req))
+            except (R.ScaleError, R.UpgradeAborted) as e:
+                self._send(409, e.record)
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": str(e)})
+
         def do_POST(self):
+            if self.path == "/admin/scale":
+                self._admin_scale()
+                return
             if self.path != "/generate":
                 self._send(404, {"error": f"no route {self.path}"})
                 return

@@ -459,6 +459,18 @@ class WorkerListener:
         with self._lock:
             return self._attached.pop(int(index), None)
 
+    def expected_indices(self) -> list:
+        """Replica indices a worker may dial in as RIGHT NOW — the
+        operator's 'which --index do I start' surface (/stats carries
+        it). Nothing about the registry is startup-static: a replica
+        born from ``add_replica`` registers its expectation through
+        the same ``expect`` call as a boot-time slot, and a retired
+        replica's ``cancel`` removes its entry for good — so a fleet
+        reshaped at runtime always advertises exactly the slots that
+        can still accept a worker."""
+        with self._lock:
+            return sorted(self._expected)
+
     # -- accept / handshake -------------------------------------------------
 
     def _event(self, kind: str, **fields) -> None:

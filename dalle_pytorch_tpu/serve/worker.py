@@ -285,8 +285,15 @@ def _run(spec: dict, conn, sender: _FrameSender, rx_seq: int) -> None:
                         **spec["engine_kwargs"])
 
     open_handles: Dict[int, S.RequestHandle] = {}
+    # READY announces the weights generation this worker actually
+    # serves: during a rolling upgrade the parent re-spawns workers on
+    # a NEW ckpt path/params, and the announcement lets the supervisor
+    # (and /healthz) verify the attach landed on the generation it
+    # asked for — a stale worker dialing a reshaped fleet advertises
+    # itself instead of silently serving old weights
     sender.send(ipc.READY, {"pid": os.getpid(), "device": str(device),
-                            "rss_mb": rss_mb()})
+                            "rss_mb": rss_mb(),
+                            "weights_version": engine.weights_version})
 
     hb_interval = float(spec.get("heartbeat_interval_s", 0.05))
     idle_sleep = float(spec.get("idle_sleep_s", 0.002))
