@@ -1284,6 +1284,18 @@ def _serve_load_point(engine, queue, rps, n_req, prompt_len):
         "mean_occupancy": round((engine.occupancy_sum - occ0)
                                 / max(d_steps, 1), 3),
     })
+    # per-phase attribution off the request traces (obs/trace.py): how
+    # much of the p95 is QUEUE rather than decode — the number that
+    # says "add a replica" vs "tune the kernel"
+    qws = sorted(
+        sum(s["total_s"] for s in r.trace.get("spans", ())
+            if s["name"] == "queue_wait")
+        for r in completed if r.ok and r.trace is not None)
+    if qws:
+        base["queue_wait_p50_ms"] = round(
+            1e3 * qws[min(len(qws) // 2, len(qws) - 1)], 2)
+        base["queue_wait_p95_ms"] = round(
+            1e3 * qws[min(int(0.95 * len(qws)), len(qws) - 1)], 2)
     return base
 
 

@@ -280,6 +280,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics", type=str, default="",
                    help="JSONL metrics file (engine stats + structured "
                         "serve events)")
+    p.add_argument("--profile_dir", type=str, default="",
+                   help="default sink for POST /admin/profile: the "
+                        "authenticated endpoint wraps the next K fused "
+                        "decode chunks in a jax.profiler trace capture "
+                        "written here (view in TensorBoard/Perfetto) — "
+                        "kernel tuning on a real chip without stopping "
+                        "the server. A capture already in flight is a "
+                        "typed 409 (docs/OBSERVABILITY.md 'Profiler "
+                        "runbook')")
     p.add_argument("--log_every", type=int, default=50,
                    help="emit an engine-stats record every N decode steps")
     p.add_argument("--init_deadline_s", type=float, default=300.0,
@@ -406,6 +415,7 @@ def main(argv=None):
         worker_quantize=args.quantize if args.worker_ckpt else "none",
         clip_params=clip_params, clip_cfg=clip_cfg, metrics=metrics,
         log_every=args.log_every, encode=vocab.encode,
+        profile_dir=args.profile_dir or None,
         init_deadline_s=args.init_deadline_s,
         init_retries=args.init_retries).start()
     kv_desc = args.kv if args.kv == "dense" \
@@ -422,6 +432,12 @@ def main(argv=None):
         f"({args.replicas} {iso_desc} replica(s){mesh_desc} x "
         f"{args.num_slots} slots, K={args.chunk_steps}, kv={kv_desc}, "
         f"queue {args.queue_depth})")
+    prof_desc = (f"; POST /admin/profile -> {args.profile_dir}"
+                 if args.profile_dir else "")
+    say(f"observability: GET /metrics (Prometheus exposition), "
+        f"GET /debug/events (flight recorder), per-request trace "
+        f"summaries on every result{prof_desc} — "
+        f"docs/OBSERVABILITY.md")
     if args.transport == "socket" and args.replicas > 1:
         listener = server.engine.listener
         say(f"worker endpoint {listener.advertise_endpoint} — attach "

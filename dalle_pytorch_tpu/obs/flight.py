@@ -1,0 +1,125 @@
+"""The flight recorder: a bounded, always-on ring of recent structured
+events and span records.
+
+``structured_event`` records today vanish unless a JSONL sink was
+configured — useless at 3am when a fence has already happened. The
+flight recorder is the serving answer: every engine (and the replica
+set itself) keeps the last N records in memory unconditionally, so
+
+  * a FENCE dumps the victim's ring straight into the
+    ``serve_replica_fenced`` event payload (for a process replica, the
+    parent-side mirror ring — fed by heartbeat/harvest frames — is what
+    survives a SIGKILL);
+  * ``GET /debug/events`` serves the set-level ring plus every live
+    replica's ring, so "why did p95 spike at 12:03" is one endpoint;
+  * typed ``UpgradeAborted``/``ScaleError`` records embed a ring tail.
+
+Records are plain JSON-scalar dicts (they cross the worker frame
+protocol verbatim). ``record`` is a lock-guarded deque append — cheap
+enough for the per-chunk span rate, and safe from every serve thread.
+
+``RecordingMetrics`` is the tee that makes "always on" true without
+touching the event emitters: it quacks like ``utils.metrics
+.MetricsLogger`` (``event``/``resilience``/``step``) but lands every
+record in a ring first and forwards to the real sink only if one was
+configured. Engines and replica sets wrap whatever ``metrics=`` they
+were given in one of these.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import List, Optional, Tuple
+
+DEFAULT_CAPACITY = 256
+
+
+class FlightRecorder:
+    """Bounded ring of recent records with a monotonically increasing
+    sequence number, so a process worker can ship INCREMENTS (``since``)
+    instead of re-sending the whole ring every heartbeat."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def seq(self) -> int:
+        """Total records ever recorded (dropped ones included)."""
+        with self._lock:
+            return self._seq
+
+    def record(self, rec: dict) -> dict:
+        """Append one record (shallow-copied — the ring must not see
+        later caller mutations)."""
+        rec = dict(rec)
+        with self._lock:
+            self._seq += 1
+            self._ring.append((self._seq, rec))
+        return rec
+
+    def dump(self) -> List[dict]:
+        """Everything currently retained, oldest first."""
+        with self._lock:
+            return [dict(rec) for _, rec in self._ring]
+
+    def tail(self, n: int) -> List[dict]:
+        """The newest ``n`` records, oldest-of-them first."""
+        with self._lock:
+            items = list(self._ring)[-max(int(n), 0):]
+        return [dict(rec) for _, rec in items]
+
+    def since(self, seq: int) -> Tuple[int, List[dict]]:
+        """Records newer than ``seq`` -> (new_seq, records). The worker
+        frame loop's incremental-ship surface; records that rotated out
+        between calls are simply gone (the ring bounds memory AND frame
+        size — retention is ``capacity``, not forever)."""
+        with self._lock:
+            out = [dict(rec) for s, rec in self._ring if s > seq]
+            return self._seq, out
+
+
+class RecordingMetrics:
+    """Tee every structured event into a ``FlightRecorder`` and forward
+    to the configured sink (if any). Presents the ``MetricsLogger``
+    surface the serve stack already talks to, so "the ring is always
+    on" costs the emitters zero new branches."""
+
+    def __init__(self, flight: FlightRecorder, inner=None):
+        self.flight = flight
+        self.inner = inner
+
+    def event(self, **fields) -> None:
+        self.flight.record(fields)
+        if self.inner is not None:
+            self.inner.event(**fields)
+
+    def resilience(self, kind: str, **fields) -> None:
+        from dalle_pytorch_tpu.utils.metrics import structured_event
+        self.flight.record(structured_event(kind, **fields))
+        if self.inner is not None:
+            self.inner.resilience(kind, **fields)
+
+    def step(self, *args, **kwargs) -> None:
+        # per-train-step records are not serve events; forward only
+        if self.inner is not None:
+            self.inner.step(*args, **kwargs)
+
+
+def wrap_metrics(flight: FlightRecorder,
+                 metrics: Optional[object]) -> RecordingMetrics:
+    """The one wrap rule: never double-wrap (a ReplicaSet engine built
+    from already-wrapped kwargs must not chain rings — the INNER sink
+    is whatever real logger sits at the bottom)."""
+    if isinstance(metrics, RecordingMetrics):
+        metrics = metrics.inner
+    return RecordingMetrics(flight, metrics)

@@ -102,7 +102,12 @@ class Autoscaler:
                 "the replica set, or drop --autoscale)")
         self.rs = replica_set
         self.policy = policy
-        self.metrics = metrics
+        # default to the SET's RecordingMetrics: every decision then
+        # lands in the set-level flight ring (always on) even when no
+        # JSONL sink was configured — "why did the fleet reshape" must
+        # be answerable from /debug/events alone
+        self.metrics = metrics if metrics is not None \
+            else getattr(replica_set, "metrics", None)
         self.clock = clock
         # per-replica pool size for the page-pressure signal. A child-
         # process engine lives in another interpreter, and num_pages=0

@@ -110,7 +110,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -124,6 +124,20 @@ COUNTERS = ("tokens_decoded", "decode_steps", "harvests",
             "occupancy_sum", "completed", "expired",
             "decode_traces", "prefill_traces", "evicted",
             "prefix_hits", "cfg_pairs")
+
+
+class ProfileError(RuntimeError):
+    """Typed rejection of a serve-side profiler capture request
+    (``Engine.request_profile`` / ``POST /admin/profile``): a capture
+    is already active (jax.profiler allows exactly one trace at a
+    time), or the target replica cannot be profiled (a child-process
+    engine's programs run in another interpreter). ``record`` is the
+    structured event — the HTTP facade maps ``capture_active`` to a
+    409, mirroring ``replica.ScaleError``."""
+
+    def __init__(self, record: dict):
+        super().__init__(f"{record.get('reason', 'profile rejected')}")
+        self.record = record
 
 
 class _Slot:
@@ -229,11 +243,13 @@ class Engine:
                  model_version: str = "0",
                  weights_version: str = "0",
                  time_admissions: bool = False,
+                 flight_events: int = 256,
                  clock: Callable[[], float] = time.perf_counter,
                  device=None):
         import jax
         import jax.numpy as jnp
 
+        from dalle_pytorch_tpu.obs import flight as oflight
         from dalle_pytorch_tpu.ops import decode as decode_ops
 
         # replica placement: committing the params pins every program
@@ -255,7 +271,15 @@ class Engine:
         if self.chunk_steps < 1:
             raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
         self.complete = complete
-        self.metrics = metrics
+        # the flight recorder (docs/OBSERVABILITY.md): the last N
+        # structured events + span records, ALWAYS on — no JSONL sink
+        # required. Every event this engine emits tees into the ring
+        # through the RecordingMetrics wrap (the configured sink, if
+        # any, still gets everything it got before), and a fence dumps
+        # the ring into the fence event payload so post-mortems don't
+        # depend on anyone having configured logging in advance.
+        self.flight = oflight.FlightRecorder(capacity=int(flight_events))
+        self.metrics = oflight.wrap_metrics(self.flight, metrics)
         self.log_every = int(log_every)
         self.quantize_cache = bool(quantize_cache)
         self.clock = clock
@@ -452,6 +476,18 @@ class Engine:
         # memo for the config-static /stats read-bytes model, keyed by
         # the sparse_reads flag it was asked for
         self._modeled_read_bytes: Dict[bool, int] = {}
+        # serve-side profiler capture (POST /admin/profile): armed by
+        # request_profile as a REQUEST the engine thread consumes at
+        # its next chunk dispatch (so the start index is read on the
+        # one thread that advances it — no HTTP-thread race), stopped
+        # after a relative countdown of harvests (so the capture covers
+        # the device actually executing the chunks, not just the async
+        # dispatches). One at a time — jax.profiler's rule.
+        self._profile_req: Optional[Tuple[str, int]] = None
+        self._profiler = None
+        self._profile_left = 0
+        self._profile_lock = threading.Lock()
+        self.profiles_taken = 0
 
         # counters (stats()/bench_serve read these)
         self.decode_traces = 0          # bumped only while TRACING: the
@@ -924,6 +960,15 @@ class Engine:
             return
         self.queue.requeue(handle)
 
+    def _span(self, handle: S.RequestHandle, name: str, now: float,
+              **meta) -> None:
+        """Stamp one trace span and land the record in the flight ring.
+        Pure host bookkeeping (a dict + two list appends) — stamping
+        inside the transfer-guarded steady state is free and safe."""
+        tr = handle.trace
+        if tr is not None:
+            self.flight.record(tr.span(name, now, **meta))
+
     def _finish(self, handle: S.RequestHandle, result: S.Result) -> None:
         if self.fenced:
             return
@@ -1256,6 +1301,7 @@ class Engine:
                 continue
             (self.cache, self.cur_tok, self.pos, self.active, self.rng,
              self.temp, self.topk_k, self.top_p, h_last) = outs
+            t_slotted = self.clock()
             for p in group:
                 i = p.slot
                 self.slots[i] = _Slot(p.handle, p.t0, now)
@@ -1263,6 +1309,10 @@ class Engine:
                     self._slot_pages[i] = list(p.grants)
                     self._pos_est[i] = p.t0
                     self._bt_dirty = True
+                if not p.uncond:    # one admit span per request, not
+                    #                 per slot of a guided pair
+                    self._span(p.handle, "prefill_admit", t_slotted,
+                               bucket=bucket, mode="cold", slot=i)
             self._wire_pairs(group)
             if self.prefix is not None:
                 for p in group:
@@ -1458,6 +1508,7 @@ class Engine:
             return
         (self.cur_tok, self.pos, self.active, self.rng, self.temp,
          self.topk_k, self.top_p) = outs
+        t_slotted = self.clock()
         for p in warm:
             i = p.slot
             self.slots[i] = _Slot(p.handle, p.t0, now)
@@ -1467,6 +1518,10 @@ class Engine:
             self._bt_dirty = True
             self.prefix_hits += 1
             self.warm_admits += 1
+            if not p.uncond:
+                self._span(p.handle, "prefill_admit", t_slotted,
+                           mode="warm", slot=i,
+                           pages_shared=p.shared_n)
             if self.metrics is not None:
                 self.metrics.event(**S.structured_event(
                     "serve_prefix_hit",
@@ -1555,6 +1610,9 @@ class Engine:
         # orphaned mid-flight ring row)
         self.tokens_decoded -= len(slot.emitted)
         self.occupancy_sum -= len(slot.emitted)
+        # the eviction is a visible timeline marker: the victim's next
+        # spans (re-queue wait, re-admission, full replay) follow it
+        self._span(slot.handle, "evict", now, pages_freed=freed)
         self._requeue_or_orphan(slot.handle)
         if self.metrics is not None:
             self.metrics.event(**S.structured_event(
@@ -1617,6 +1675,40 @@ class Engine:
         the outputs are futures, and the device starts computing while
         the host goes on to admit/harvest."""
         cold = self.decode_traces == 0      # first call traces+compiles
+        if self._profile_req is not None and self._profiler is None \
+                and self.decode_traces > 0:
+            # consume the armed request HERE, on the engine thread that
+            # advances the dispatch counter — "profile the next K
+            # chunks" starts at exactly this dispatch, whatever index
+            # it happens to be (an HTTP-thread-precomputed index could
+            # be skipped forever if a dispatch raced the arm). Never on
+            # the COLD dispatch: a trace wrapping the one-time decode
+            # compile swamps the capture AND its stop-time xplane
+            # serialization can stall this thread past the replica
+            # hang deadline — a capture armed before warm-up simply
+            # begins at the first steady-state chunk
+            with self._profile_lock:
+                req, self._profile_req = self._profile_req, None
+            if req is not None:
+                from dalle_pytorch_tpu.utils.profiling import StepProfiler
+                log_dir, chunks = req
+                start = self.decode_steps // self.chunk_steps
+                prof = StepProfiler(log_dir, start=start, steps=chunks)
+                # stop after the chunks already in flight (they harvest
+                # first, FIFO) plus ours have ALL harvested — a relative
+                # countdown, immune to any dispatch/harvest skew a past
+                # fail_active left behind
+                self._profile_left = len(self._pending) + chunks
+                # publish BEFORE start_trace: the call can block for
+                # seconds syncing behind another replica's in-flight
+                # compile, and profile_active() is the supervisor's
+                # hang-deadline exemption for exactly that stall
+                self._profiler = prof
+                try:
+                    prof.maybe_start(start)
+                except BaseException:
+                    self._profiler = None
+                    raise
         if cold:
             self.compiling = True
         try:
@@ -1664,6 +1756,14 @@ class Engine:
         rec = self._pending.popleft()
         ring, active_after = jax.device_get([rec.ring, rec.active])
         self.harvests += 1
+        if self._profiler is not None:
+            # chunks harvest FIFO, so the countdown set at capture
+            # start reaches zero exactly when the LAST captured chunk
+            # has finished EXECUTING (the device_get above synced it),
+            # not merely been dispatched
+            self._profile_left -= 1
+            if self._profile_left <= 0:
+                self._finish_profile()
         now = self.clock()
         # the harvest's device_get is the one blocking sync in steady
         # state — exactly where a wedged device stalls the thread, so
@@ -1691,6 +1791,13 @@ class Engine:
             toks = row[row >= 0]
             slot.emitted.extend(int(t) for t in toks)
             emitted += len(toks)
+            if len(toks):
+                # per-chunk decode attribution: one span per harvested
+                # chunk per request, tiling from the previous harvest
+                # (or the admit) to THIS harvest — where the request's
+                # decode milliseconds actually went
+                self._span(slot.handle, "decode_chunk", now,
+                           tokens=int(len(toks)))
             if not bool(active_after[i]):
                 self._complete(i, slot, now)
         self.tokens_decoded += emitted
@@ -1735,6 +1842,13 @@ class Engine:
         ``analysis.guards.no_transfers()``."""
         with self._lock:
             if self.fenced:
+                if self._profiler is not None:
+                    # a capture orphaned by the fence: close it on THIS
+                    # thread (jax.profiler is process-global — left
+                    # open it would poison every future capture and
+                    # crash the next start_trace anywhere in-process)
+                    self._profiler.close()
+                    self._profiler = None
                 return False        # reclaimed: this pool is dead weight
             now = self.clock()
             self.last_heartbeat = now
@@ -1785,6 +1899,15 @@ class Engine:
                     if h.request.request_id == self._hol_rid:
                         self._hol_rid = None
                         self._hol_need = 0
+            for h in ready:
+                # queue_wait closes HERE for a single-engine pop; a
+                # replica-set router already stamped it at routing
+                # (has_in_attempt keeps the two shapes from double-
+                # counting), and a page-deferred re-pop folds its extra
+                # wait into the next prefill_admit span
+                if h.trace is not None \
+                        and not h.trace.has_in_attempt("queue_wait"):
+                    self._span(h, "queue_wait", now)
             if ready:
                 # published for the reclaim sweep BEFORE admission can
                 # block on a compile (see _admitting)
@@ -1808,6 +1931,15 @@ class Engine:
             while len(self._pending) > target:
                 self._harvest_chunk()
                 did = True
+
+            if self._profiler is not None and not dispatched \
+                    and not self._pending:
+                # the engine drained before the capture's K chunks ran:
+                # close it NOW with what it got (partial but valid) —
+                # an open process-global trace slows every replica in
+                # this process until the next traffic arrives, and "the
+                # next K chunks" cannot honestly outlive the work
+                self._finish_profile(partial=True)
 
             if (self.metrics is not None and self.log_every
                     and self.decode_steps - self._last_log
@@ -1861,6 +1993,11 @@ class Engine:
                 continue                    # persistent fault
             if not busy and self.idle():
                 stop.wait(idle_sleep_s)
+        if self._profiler is not None:
+            # clean shutdown with a capture in flight: stop the
+            # process-global trace (partial but valid) on the way out
+            self._profiler.close()
+            self._profiler = None
 
     def _terminate_active(self, status: str, reason: str) -> int:
         """Fulfil every in-slot request with a typed terminal result and
@@ -1887,6 +2024,12 @@ class Engine:
                 self._free_slot(i)
                 n += 1
             self._pending.clear()
+            if self._profiler is not None:
+                # the chunks a capture was waiting on died with the
+                # pipeline; close the trace (partial but valid) rather
+                # than leaving jax.profiler wedged open forever
+                self._profiler.close()
+                self._profiler = None
             self.cur_tok = jnp.zeros((self.num_slots,), jnp.int32)
             self.pos = jnp.zeros((self.num_slots,), jnp.int32)
             self.active = jnp.zeros((self.num_slots,), bool)
@@ -1907,6 +2050,80 @@ class Engine:
         return self._terminate_active(S.CANCELLED, reason)
 
     # -- observability ------------------------------------------------------
+
+    def _finish_profile(self, partial: bool = False) -> None:
+        """Stop the in-flight capture and emit ``serve_profile_done``
+        (``partial`` when the engine drained before the requested K
+        chunks ran). Engine-thread only."""
+        prof = self._profiler
+        if prof is None:
+            return
+        # close BEFORE clearing: stop_trace serializes the xplane for
+        # seconds, and profile_active() must stay true the whole time —
+        # it is the supervisor's hang-deadline exemption (clearing
+        # first opens a window where a sweep sees a stale heartbeat,
+        # no capture, and fences a healthy replica mid-serialization)
+        prof.close()
+        self._profiler = None
+        self.profiles_taken += 1
+        rec = S.structured_event(
+            "serve_profile_done", dir=prof.log_dir,
+            chunks=prof.stop_at - prof.start)
+        if partial:
+            rec["partial"] = True
+        self.metrics.event(**rec)
+
+    def request_profile(self, log_dir: str, chunks: int = 8) -> dict:
+        """Arm a ``jax.profiler`` capture over the NEXT ``chunks`` fused
+        decode chunks (``POST /admin/profile``; reuses
+        ``utils.profiling.StepProfiler``). The capture starts at the
+        next STEADY-STATE chunk dispatch (the one-time cold compile is
+        never captured — it would swamp the trace and stall the serving
+        thread past supervision deadlines) and stops once that many
+        chunks have been harvested — kernel tuning on a real chip
+        without stopping the server. Typed ``ProfileError`` (reason
+        ``capture_active``, HTTP 409) while a capture is in flight:
+        jax.profiler allows exactly one trace at a time."""
+        chunks = int(chunks)
+        if chunks < 1:
+            raise ValueError(f"chunks must be >= 1, got {chunks}")
+        if not log_dir:
+            raise ValueError("request_profile needs a log_dir "
+                             "(serve_dalle --profile_dir sets the "
+                             "default sink)")
+        with self._profile_lock:
+            prof = self._profiler
+            if prof is not None:
+                raise ProfileError(S.structured_event(
+                    "serve_profile_reject", reason="capture_active",
+                    dir=prof.log_dir, start_chunk=prof.start,
+                    chunks=prof.stop_at - prof.start))
+            if self._profile_req is not None:
+                raise ProfileError(S.structured_event(
+                    "serve_profile_reject", reason="capture_active",
+                    dir=self._profile_req[0],
+                    chunks=self._profile_req[1]))
+            self._profile_req = (str(log_dir), chunks)
+        rec = S.structured_event(
+            "serve_profile_armed", dir=str(log_dir), chunks=chunks,
+            # advisory: the engine thread picks the REAL start index at
+            # its next dispatch (_dispatch_chunk consumes the request)
+            start_chunk=self.decode_steps // self.chunk_steps)
+        self.metrics.event(**rec)
+        return rec
+
+    def profile_active(self) -> bool:
+        """A capture is pending or running — the arm-time 409 surface
+        (a second arm must be refused in either state)."""
+        return self._profiler is not None or self._profile_req is not None
+
+    def capturing(self) -> bool:
+        """A jax.profiler trace is actually RUNNING (start_trace called
+        or in progress, not yet closed) — the supervision-exemption
+        surface. An armed-but-not-yet-started request slows nothing,
+        and exempting it would let a wedged replica that never reaches
+        its next dispatch evade the hang deadline forever."""
+        return self._profiler is not None
 
     def prefill_trace_count(self, bucket: int) -> int:
         """Traces of one bucket's prefill program (contract: <= 1 for the
@@ -2039,4 +2256,10 @@ class Engine:
             "harvests": self.harvests,
             "host_round_trips_per_token": round(
                 self.harvests / max(self.tokens_decoded, 1), 6),
+            # the obs surface: flight-recorder occupancy (retention is
+            # the ring capacity, /debug/events serves the contents) and
+            # the serve-side profiler state
+            "flight_events": len(self.flight),
+            "profile_active": self.profile_active(),
+            "profiles_taken": self.profiles_taken,
         }

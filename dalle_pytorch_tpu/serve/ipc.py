@@ -70,6 +70,7 @@ import zlib
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from dalle_pytorch_tpu.obs import flight as oflight
 from dalle_pytorch_tpu.serve import scheduler as S
 from dalle_pytorch_tpu.serve import transport as T
 from dalle_pytorch_tpu.serve.engine import COUNTERS
@@ -398,6 +399,12 @@ class ChildEngineClient:
         # the reclaim surface, owned and trusted by the parent only
         self.shadow: Dict[int, S.RequestHandle] = {}
 
+        # parent-side MIRROR of the child engine's flight recorder:
+        # heartbeat/harvest frames carry the child ring's increments,
+        # so the last-N events of a SIGKILLed child survive here — the
+        # fence dump reads this mirror, never asks the corpse
+        self.flight = oflight.FlightRecorder(capacity=512)
+
         # last-frame mirror of the child engine's state
         self.counter_state = {k: 0 for k in COUNTERS}
         self.progress: Dict[int, int] = {}
@@ -558,10 +565,16 @@ class ChildEngineClient:
             self.worker_weights_version = \
                 str(payload.get("weights_version") or "")
         elif kind in (HEARTBEAT, HARVEST):
-            # results FIRST, snapshot second: the snapshot in a frame
-            # counts the completions whose results ride the same frame,
-            # so absorbing in this order keeps parent state consistent
-            # even if a later frame never arrives
+            # flight-ring increments first (the mirror should already
+            # hold the spans/events that EXPLAIN a result when it
+            # lands), then results, then the snapshot that counts them
+            # — absorbing in this order keeps parent state consistent
+            # even if a later frame never arrives. .get + isinstance:
+            # a pre-obs worker ships no events; a malformed entry is
+            # advisory observability, dropped rather than fenced over.
+            for ev in payload.get("events") or ():
+                if isinstance(ev, dict):
+                    self.flight.record(ev)
             if kind == HARVEST:
                 for d in payload.get("results", ()):
                     self._absorb_result(d)
@@ -589,6 +602,14 @@ class ChildEngineClient:
         handle = self.shadow.pop(result.request_id, None)
         if handle is None or handle.done():
             return      # reclaimed+replayed already, or a stale echo
+        # the child's span records ride the result frame: merge them
+        # into the parent trace (same machine, one CLOCK_MONOTONIC
+        # epoch, so they tile against the parent's route span) and
+        # re-anchor the tiling pointer at the absorb instant — the
+        # postprocess span starts here. Advisory: malformed spans are
+        # skipped inside merge_wire, never fence material.
+        if handle.trace is not None and d.get("spans"):
+            handle.trace.merge_wire(d["spans"], self.clock())
         # honest caller-observed latency: restamp against the PARENT
         # clock and the caller's real submit time (the child's stamps
         # are relative to its own admission)

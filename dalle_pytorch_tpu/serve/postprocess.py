@@ -98,7 +98,26 @@ class PostProcessor:
     def pending(self) -> int:
         return self._q.qsize()
 
+    def _trace_span(self, handle: S.RequestHandle,
+                    error: bool = False) -> None:
+        """Close the request's timeline over this stage: the
+        ``postprocess`` span tiles from the engine's last harvest (or,
+        process mode, the parent's result-absorb instant) to here —
+        the VAE/CLIP milliseconds the caller actually waited for."""
+        tr = getattr(handle, "trace", None)
+        if tr is not None:
+            meta = {"clip": self._score is not None}
+            if error:
+                meta["error"] = True
+            tr.span("postprocess", time.perf_counter(), **meta)
+
     def _fulfill(self, handle: S.RequestHandle, result: S.Result) -> None:
+        tr = getattr(handle, "trace", None)
+        if tr is not None and result.trace is None:
+            # summarize BEFORE the stats hook runs: _record_latency
+            # reads result.trace for the prefill span (handle.fulfill
+            # would attach the same summary, but only after the hook)
+            result.trace = tr.summary()
         if self.on_fulfill is not None:
             try:
                 self.on_fulfill(result)
@@ -143,6 +162,7 @@ class PostProcessor:
                 self.decoded += 1
                 result.total_s = round(
                     result.total_s + (time.perf_counter() - t0), 6)
+                self._trace_span(handle)
                 self._fulfill(handle, result)
             except Exception as e:      # noqa: BLE001 — no-hangs contract
                 result = S.Result(
@@ -152,6 +172,7 @@ class PostProcessor:
                     queued_s=result.queued_s, decode_s=result.decode_s,
                     total_s=round(result.total_s
                                   + (time.perf_counter() - t0), 6))
+                self._trace_span(handle, error=True)
                 self._fulfill(handle, result)
                 if self.metrics is not None:
                     self.metrics.event(**S.structured_event(

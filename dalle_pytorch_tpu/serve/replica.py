@@ -353,7 +353,15 @@ class ReplicaSet:
         self.queue = queue
         self.n_replicas = int(replicas)
         self.complete = complete
-        self.metrics = metrics
+        # set-level flight recorder (docs/OBSERVABILITY.md): routing/
+        # supervision/scale/upgrade lifecycle events and the router's
+        # trace spans, always on — fence events land here WITH the
+        # victim replica's own ring embedded, so /debug/events can
+        # reconstruct a failover after the victim is gone
+        from dalle_pytorch_tpu.obs import flight as oflight
+        self.flight = oflight.FlightRecorder(capacity=512)
+        self.metrics = oflight.wrap_metrics(self.flight, metrics)
+        self.fence_dumps: dict = {}     # replica index -> last dump
         self.clock = clock
         self.heartbeat_s = float(heartbeat_s)
         self.kv = str(kv)
@@ -471,6 +479,39 @@ class ReplicaSet:
                 self.metrics.event(**S.structured_event(kind, **fields))
             except Exception:   # noqa: BLE001 — observability must never
                 pass            # take down supervision
+
+    def _mark_replay(self, h: S.RequestHandle, reason: str,
+                     replica: int) -> None:
+        """Stamp the failover-replay link on a reclaimed handle's trace:
+        the ``replayed_from`` span covers the fence gap (victim's last
+        progress -> re-queue) under its own name — the timeline shows a
+        labeled gap, never decode time that didn't happen — and opens
+        the next attempt. The marker also lands in the set ring."""
+        if h.trace is not None:
+            self.flight.record(h.trace.replay(
+                self.clock(), reason=reason, replica=replica))
+
+    def _scale_error(self, op: str, **fields) -> ScaleError:
+        """A typed reshape rejection with the set ring's tail embedded:
+        the refusal record carries the recent lifecycle events that
+        explain WHY (who is mid-upgrade, which bring-up failed), so the
+        operator's 409 body is a diagnosis, not just a verdict."""
+        return ScaleError(S.structured_event(
+            "serve_scale_reject", op=op, **fields,
+            flight=self.flight.tail(32)))
+
+    def debug_events(self) -> dict:
+        """The ``GET /debug/events`` body: the set-level ring, every
+        live replica's ring (a process replica's parent-side mirror),
+        and the last fence dump per fenced replica index."""
+        out = {"server": self.flight.dump(), "replicas": {},
+               "fenced": {str(i): d for i, d in
+                          self.fence_dumps.items()}}
+        for r in self.replicas:
+            fl = getattr(r.engine, "flight", None)
+            if fl is not None:
+                out["replicas"][str(r.index)] = fl.dump()
+        return out
 
     def _device_for(self, i: int):
         """Placement for replica ``i`` — shared by the constructor and
@@ -729,6 +770,7 @@ class ReplicaSet:
                 # original arrival position: zero-loss AND no
                 # queue-jumping — a replayed request neither loses
                 # its place nor steals anyone else's
+                self._mark_replay(h, reason, r.index)
                 self.queue.requeue(h)
                 reclaimed += 1
             if hol is not None and hol[0] in seen:
@@ -736,9 +778,15 @@ class ReplicaSet:
                 self.hol_handoffs += 1
                 self._event("serve_hol_handoff", replica=r.index,
                             request_id=hol[0], pages_needed=hol[1])
+        # the victim's flight recorder rides the fence event: the ring
+        # was always on, so the post-mortem exists even when no JSONL
+        # sink was ever configured
+        dump = eng.flight.dump() if eng is not None \
+            and getattr(eng, "flight", None) is not None else []
+        self.fence_dumps[r.index] = dump
         self.reclaimed += reclaimed
         self._event("serve_replica_fenced", replica=r.index,
-                    reason=reason, reclaimed=reclaimed)
+                    reason=reason, reclaimed=reclaimed, flight=dump)
         return reclaimed
 
     def _fence_and_reclaim_child(self, r: _Replica, now: float,
@@ -778,6 +826,7 @@ class ReplicaSet:
                 rids.add(rid)
                 # original arrival position: zero-loss AND no
                 # queue-jumping, same as the thread path
+                self._mark_replay(h, reason, r.index)
                 self.queue.requeue(h)
                 reclaimed += 1
             # the child's last-frame HOL reservation (serve/ipc.py
@@ -789,10 +838,17 @@ class ReplicaSet:
                 self._event("serve_hol_handoff", replica=r.index,
                             request_id=client.hol[0],
                             pages_needed=client.hol[1])
+        # the parent-side MIRROR ring (fed by the frames the child
+        # shipped before dying) is what a SIGKILL cannot destroy: the
+        # dump is whatever the victim managed to tell us, which the
+        # frame protocol guarantees is a consistent prefix
+        dump = client.flight.dump() if client is not None \
+            and getattr(client, "flight", None) is not None else []
+        self.fence_dumps[r.index] = dump
         self.reclaimed += reclaimed
         self._event("serve_replica_fenced", replica=r.index,
                     reason=reason, reclaimed=reclaimed,
-                    exit=r.last_exit)
+                    exit=r.last_exit, flight=dump)
         return reclaimed
 
     def _failover(self, r: _Replica, now: float, reason: str) -> None:
@@ -833,22 +889,18 @@ class ReplicaSet:
         retired tombstone or an out-of-range index must never be acted
         on half-way."""
         if not 0 <= index < len(self.replicas):
-            raise ScaleError(S.structured_event(
-                "serve_scale_reject", op=op, replica=index,
-                reason="no_such_replica",
-                replicas=len(self.replicas)))
+            raise self._scale_error(op, replica=index,
+                                    reason="no_such_replica",
+                                    replicas=len(self.replicas))
         r = self.replicas[index]
         if r.state == RETIRED:
-            raise ScaleError(S.structured_event(
-                "serve_scale_reject", op=op, replica=index,
-                reason="replica_retired"))
+            raise self._scale_error(op, replica=index,
+                                    reason="replica_retired")
         return r
 
     def _reject_mid_upgrade(self, op: str) -> None:
         if self._upgrading:
-            raise ScaleError(S.structured_event(
-                "serve_scale_reject", op=op,
-                reason="upgrade_in_progress"))
+            raise self._scale_error(op, reason="upgrade_in_progress")
 
     def add_replica(self) -> int:
         """Runtime scale-out: append one new supervised slot — same
@@ -866,11 +918,10 @@ class ReplicaSet:
             self._reject_mid_upgrade("add")
             active = [r for r in self.replicas if r.state != RETIRED]
             if self.max_replicas and len(active) >= self.max_replicas:
-                raise ScaleError(S.structured_event(
-                    "serve_scale_reject", op="add",
-                    reason="scale_out_past_cap",
+                raise self._scale_error(
+                    "add", reason="scale_out_past_cap",
                     replicas=len(active),
-                    max_replicas=self.max_replicas))
+                    max_replicas=self.max_replicas)
             index = len(self.replicas)
             r = _Replica(index, device=self._device_for(index),
                          version=self.weights_version)
@@ -900,9 +951,8 @@ class ReplicaSet:
             survivors = [x for x in self.replicas
                          if x is not r and x.state != RETIRED]
             if not survivors:
-                raise ScaleError(S.structured_event(
-                    "serve_scale_reject", op="remove", replica=index,
-                    reason="remove_last_replica"))
+                raise self._scale_error("remove", replica=index,
+                                        reason="remove_last_replica")
             n = self._fence_and_reclaim(r, self.clock(), reason)
             r.state = RETIRED
             r.params_override = None
@@ -1010,7 +1060,10 @@ class ReplicaSet:
         raise UpgradeAborted(S.structured_event(
             "serve_upgrade_aborted", replica=r.index, to=version,
             error=error, rolled_back=[x.index for x in rollback],
-            fleet_version=old_version))
+            fleet_version=old_version,
+            # the set ring's tail: the drain/bring-up/canary events of
+            # the failed cycle ride the abort record itself
+            flight=self.flight.tail(64)))
 
     def rolling_upgrade(self, *, version: str, params=None,
                         ckpt: Optional[str] = None,
@@ -1049,22 +1102,21 @@ class ReplicaSet:
         with self._ctl_lock:
             self._reject_mid_upgrade("upgrade")
             if not version or version == self.weights_version:
-                raise ScaleError(S.structured_event(
-                    "serve_scale_reject", op="upgrade",
-                    reason="version_unchanged",
-                    weights_version=self.weights_version))
+                raise self._scale_error(
+                    "upgrade", reason="version_unchanged",
+                    weights_version=self.weights_version)
             if (params is None) == (ckpt is None):
-                raise ScaleError(S.structured_event(
-                    "serve_scale_reject", op="upgrade",
-                    reason="need_exactly_one_of_params_or_ckpt"))
+                raise self._scale_error(
+                    "upgrade",
+                    reason="need_exactly_one_of_params_or_ckpt")
             if ckpt is not None and self.worker_ckpt is None:
-                raise ScaleError(S.structured_event(
-                    "serve_scale_reject", op="upgrade",
-                    reason="ckpt_upgrade_needs_worker_ckpt_set"))
+                raise self._scale_error(
+                    "upgrade",
+                    reason="ckpt_upgrade_needs_worker_ckpt_set")
             if params is not None and self.worker_ckpt is not None:
-                raise ScaleError(S.structured_event(
-                    "serve_scale_reject", op="upgrade",
-                    reason="params_upgrade_on_worker_ckpt_set"))
+                raise self._scale_error(
+                    "upgrade",
+                    reason="params_upgrade_on_worker_ckpt_set")
             self._upgrading = True
         # EVERYTHING past the flag runs under the finally that clears
         # it — an exception anywhere here (even a bad canaries value)
@@ -1219,6 +1271,22 @@ class ReplicaSet:
         driver itself is the loop, so a hang would block the driver,
         and crashes surface synchronously in ``step_once``."""
         did = False
+        # a serve-side jax.profiler capture (POST /admin/profile) is
+        # PROCESS-global: while one is RUNNING on any thread-mode
+        # replica, every replica in this process runs slower (TraceMe
+        # overhead, stop-time serialization, core contention) — exempt
+        # them all from the hang deadline exactly like ``compiling``
+        # (operator-initiated, bounded at K chunks, and fencing mid-
+        # capture would both lose the replica and leak the global
+        # trace open). Engine.capturing is a started trace only: an
+        # armed-but-unconsumed request must NOT suppress fencing (a
+        # wedged replica that never reaches its next dispatch would
+        # otherwise evade the deadline forever)
+        capturing = self.isolation != "process" and any(
+            r.engine is not None
+            and getattr(r.engine, "capturing", None) is not None
+            and r.engine.capturing()
+            for r in self.replicas if r.state == RUNNING)
         for r in self.replicas:
             if r.state == RUNNING and self.isolation == "process":
                 did = self._check_child(r, now) or did
@@ -1232,6 +1300,7 @@ class ReplicaSet:
                     did = True
                 elif r.thread is not None and r.engine is not None \
                         and not r.engine.compiling \
+                        and not capturing \
                         and now - r.engine.last_heartbeat \
                         > self.heartbeat_s:
                     # ``compiling`` exempts a known first-call trace/
@@ -1486,6 +1555,15 @@ class ReplicaSet:
                 # pin at first routing: from here on, failover replay
                 # of this request goes only to this weights generation
                 h.replay_version = r.version
+            if h.trace is not None:
+                # the shared-queue wait closes here; the zero-duration
+                # route marker carries WHERE the request went (the
+                # engine-side spans then tile from this instant)
+                if not h.trace.has_in_attempt("queue_wait"):
+                    self.flight.record(h.trace.span("queue_wait", now))
+                self.flight.record(h.trace.span(
+                    "route", now, replica=r.index,
+                    weights_version=r.version))
             self._hol_handoff.pop(h.request.request_id, None)
             self._version_holds.discard(h.request.request_id)
             caps[r.index] -= 1
@@ -1923,6 +2001,7 @@ class ReplicaSet:
             "upgrades": self.upgrades,
             "upgrading": self._upgrading,
             "hol_handoffs": self.hol_handoffs,
+            "flight_events": len(self.flight),
             "per_replica": per,
         }
         if proc:

@@ -27,6 +27,7 @@ import time
 from collections import defaultdict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from dalle_pytorch_tpu.obs import trace as otrace
 from dalle_pytorch_tpu.utils.metrics import structured_event
 
 # Result.status values — the full set of terminal request states.
@@ -219,6 +220,12 @@ class Result:
     queued_s: float = 0.0
     decode_s: float = 0.0
     total_s: float = 0.0
+    # the trace summary (obs/trace.py): span timeline aggregated by
+    # name + replay edges. Attached by RequestHandle.fulfill from the
+    # handle's trace — never crosses the wire itself (a child's spans
+    # ride the result frame raw; the parent re-summarizes its merged
+    # trace, so the summary always describes the CALLER's timeline)
+    trace: Optional[dict] = None
 
     @property
     def ok(self) -> bool:
@@ -284,6 +291,10 @@ class RequestHandle:
         self._done = threading.Event()
         self._result: Optional[Result] = None
         self._fulfill_lock = threading.Lock()
+        # the request's span timeline (obs/trace.py), attached at
+        # submit (None for hand-built handles — canaries, raw-queue
+        # tests — which trace nothing)
+        self.trace: Optional[otrace.Trace] = None
         # arrival order within the priority class, assigned at submit;
         # requeue (eviction/page-defer) re-inserts with the SAME seq so
         # a request never loses its place in line — without this, a
@@ -307,6 +318,12 @@ class RequestHandle:
         with self._fulfill_lock:
             if self._done.is_set():
                 return False
+            if self.trace is not None and result.trace is None:
+                # the ONE summary site: every terminal path (completion,
+                # postprocess, expiry, cancellation, failover replay)
+                # funnels through fulfill, so the caller always sees
+                # the timeline that actually produced its result
+                result.trace = self.trace.summary()
             self._result = result
             self._done.set()
             return True
@@ -323,17 +340,31 @@ class RequestHandle:
         the original arrival position MUST survive the process boundary,
         or a request reclaimed from a dead child and replayed would lose
         its no-starvation guarantee (``requeue`` re-enters at
-        ``queue_seq``)."""
-        return {**self.request.to_wire(now), "seq": int(self.queue_seq)}
+        ``queue_seq``). The trace identity (id + attempt) rides along so
+        the child's span records carry the SAME trace_id the caller's
+        timeline is keyed by."""
+        d = {**self.request.to_wire(now), "seq": int(self.queue_seq)}
+        if self.trace is not None:
+            d["trace_id"] = self.trace.trace_id
+            d["attempt"] = int(self.trace.attempt)
+        return d
 
     @classmethod
     def from_wire(cls, d: dict, now: float) -> "RequestHandle":
         """Child-side reconstruction: a LOCAL stand-in handle whose
         fulfillment the worker observes and ships back as a result
         frame — the parent's real handle (the caller's future) never
-        leaves the parent process."""
+        leaves the parent process. The stand-in gets its own trace
+        under the wire's trace_id/attempt: its spans ship back with
+        the result and merge into the parent trace (.get: frames from
+        a pre-tracing peer simply decode traceless)."""
         handle = cls(Request.from_wire(d, now))
         handle.queue_seq = int(d["seq"])
+        tid = d.get("trace_id")
+        if tid is not None:
+            otrace.attach(handle, handle.request.request_id, now,
+                          trace_id=str(tid),
+                          attempt=int(d.get("attempt", 0)))
         return handle
 
 
@@ -410,6 +441,12 @@ class RequestQueue:
                                           submit_t=now)
             handle = RequestHandle(request)
             handle.queue_seq = next(self._seq)
+            # every submitted request is traced (obs/trace.py): the
+            # zero-duration submit marker anchors the tiling timeline
+            # at the exact instant the caller's latency clock starts
+            otrace.attach(handle, rid, now).span(
+                "submit", now, priority=int(request.priority),
+                prompt_len=len(request.codes))
             heapq.heappush(self._heap,
                            (request.priority, handle.queue_seq, handle))
             return handle

@@ -23,21 +23,12 @@ from __future__ import annotations
 
 import json
 import threading
-from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional
 
 from dalle_pytorch_tpu.serve import engine as engine_mod
 from dalle_pytorch_tpu.serve import postprocess as post_mod
 from dalle_pytorch_tpu.serve import scheduler as S
-
-
-def _percentile(sorted_vals: List[float], q: float) -> float:
-    """Nearest-rank percentile; [] -> 0.0 (no completed requests yet)."""
-    if not sorted_vals:
-        return 0.0
-    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
-    return sorted_vals[i]
 
 
 class InferenceServer:
@@ -86,11 +77,15 @@ class InferenceServer:
                  clip_params: Optional[dict] = None, clip_cfg=None,
                  decode_images: bool = True,
                  metrics=None, log_every: int = 50,
+                 profile_dir: Optional[str] = None,
                  encode: Optional[Callable[[str], List[int]]] = None,
                  init_deadline_s: float = 0.0, init_retries: int = 3):
         self.cfg = cfg
         self.metrics = metrics
         self.encode = encode
+        # default sink for POST /admin/profile (a request body may name
+        # its own dir; with neither, the capture is a typed refusal)
+        self.profile_dir = profile_dir or None
         # server-wide guidance default: a request that doesn't carry
         # its own cfg_scale samples with this one (0 = unguided)
         self.default_cfg_scale = float(default_cfg_scale)
@@ -161,14 +156,10 @@ class InferenceServer:
             # a prompt the slot pool can't hold is rejected HERE (typed
             # InvalidRequest / HTTP 400), before it can reach the engine
             max_prompt_len=cfg.text_seq_len,
-            on_event=(lambda rec: metrics.event(**rec))
-            if metrics is not None else None)
-        self.post = None
-        if decode_images:
-            self.post = post_mod.PostProcessor(
-                params, vae_params, cfg, clip_params=clip_params,
-                clip_cfg=clip_cfg, metrics=metrics,
-                on_fulfill=self._record_latency)
+            # submit-time rejects land in the flight ring (always on)
+            # AND the JSONL sink (when configured) — self.engine exists
+            # by the first runtime submit
+            on_event=self._queue_event)
         if self._is_set:
             from dalle_pytorch_tpu.serve import replica as replica_mod
             self.engine = replica_mod.ReplicaSet(
@@ -192,8 +183,13 @@ class InferenceServer:
                 max_replicas=self.max_replicas)
             if self.autoscale_policy is not None:
                 from dalle_pytorch_tpu.serve.autoscale import Autoscaler
+                # the set's RecordingMetrics: every autoscale_decision
+                # lands in the set-level flight ring (and the JSONL
+                # sink when one exists) — "why did the fleet reshape"
+                # is answerable from /debug/events alone
                 self.autoscaler = Autoscaler(
-                    self.engine, self.autoscale_policy, metrics=metrics)
+                    self.engine, self.autoscale_policy,
+                    metrics=self.engine.metrics)
         elif self.mesh_devices > 1:
             # ONE logical engine pjit-sharded over a device mesh — the
             # serve surface is identical (docs/SERVING.md 'Mesh-sharded
@@ -228,15 +224,57 @@ class InferenceServer:
                 weights_version=self.weights_version,
                 model_version=self.weights_version)
 
-        # bounded window: p50/p95 over the last 10k completions — an
-        # unbounded list would grow (and re-sort under the lock) forever
-        # on a long-lived server
-        self._latencies: deque = deque(maxlen=10_000)
-        self._lat_lock = threading.Lock()
+        # the postprocess stage is built AFTER the engine(s) so its
+        # structured events tee into the same flight ring the engine's
+        # do (RecordingMetrics — docs/OBSERVABILITY.md)
+        self.post = None
+        if decode_images:
+            self.post = post_mod.PostProcessor(
+                params, vae_params, cfg, clip_params=clip_params,
+                clip_cfg=clip_cfg, metrics=self.engine.metrics,
+                on_fulfill=self._record_latency)
+
+        # /metrics exposition (obs/registry.py): the sliding-window
+        # latency histograms, labeled per weights_version so a rolling
+        # upgrade's two generations are distinguishable on a dashboard.
+        # Counters/gauges are projected from the live /stats dicts at
+        # scrape time — one source of truth, no second set of state.
+        from dalle_pytorch_tpu.obs import registry as obs_registry
+        self.registry = obs_registry.Registry()
+        self.hist_e2e = self.registry.histogram(
+            "dalle_serve_e2e_latency_seconds",
+            "End-to-end latency of successful requests "
+            "(submit -> caller-visible fulfilment)")
+        self.hist_queue_wait = self.registry.histogram(
+            "dalle_serve_queue_wait_seconds",
+            "Queue wait of successful requests (submit -> admission)")
+        self.hist_prefill = self.registry.histogram(
+            "dalle_serve_prefill_seconds",
+            "Prefill/admission span per successful request "
+            "(pop -> slotted; trace span prefill_admit)",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0))
+        self.hist_ms_per_token = self.registry.histogram(
+            "dalle_serve_decode_ms_per_token",
+            "Decode milliseconds per generated token, per successful "
+            "request",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
+                     50.0, 100.0, 250.0, 1000.0))
+        # serializes /admin/profile's sibling-capture check + arm (two
+        # concurrent POSTs targeting different thread-mode replicas
+        # must not both pass the per-process-singleton guard)
+        self._profile_arm_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     # -- stage glue ---------------------------------------------------------
+
+    def _queue_event(self, rec: dict) -> None:
+        fl = getattr(self.engine, "flight", None)
+        if fl is not None:
+            fl.record(rec)
+        if self.metrics is not None:
+            self.metrics.event(**rec)
 
     def _record_latency(self, result: S.Result) -> None:
         # successful completions only: mixing in error results (whose
@@ -244,8 +282,24 @@ class InferenceServer:
         # failing dependency makes the tail matter most
         if not result.ok:
             return
-        with self._lat_lock:
-            self._latencies.append(result.total_s)
+        # histogram feed: exactly once per DELIVERED request (this hook
+        # runs at the single fulfilment funnel), so the e2e histogram's
+        # count equals distinct delivered requests — the /metrics
+        # acceptance contract. weights_version labels keep a rolling
+        # upgrade's generations separable.
+        v = result.weights_version or ""
+        self.hist_e2e.observe(result.total_s, weights_version=v)
+        self.hist_queue_wait.observe(result.queued_s, weights_version=v)
+        if result.tokens is not None and result.decode_s > 0:
+            self.hist_ms_per_token.observe(
+                1e3 * result.decode_s / max(len(result.tokens), 1),
+                weights_version=v)
+        tr = result.trace
+        if tr is not None:
+            prefill = sum(s["total_s"] for s in tr.get("spans", ())
+                          if s.get("name") == "prefill_admit")
+            if prefill > 0:
+                self.hist_prefill.observe(prefill, weights_version=v)
 
     def _on_decoded(self, handle: S.RequestHandle,
                     result: S.Result) -> None:
@@ -255,6 +309,12 @@ class InferenceServer:
             # describe what the caller actually waited for
             self.post.submit(handle, result)
         else:
+            tr = getattr(handle, "trace", None)
+            if tr is not None and result.trace is None:
+                # summarize before the histogram feed (same rule as
+                # PostProcessor._fulfill): _record_latency reads
+                # result.trace for the prefill span
+                result.trace = tr.summary()
             self._record_latency(result)
             handle.fulfill(result)
 
@@ -464,17 +524,218 @@ class InferenceServer:
             "serve_scale_reject", op=op, reason="unknown_op"))
 
     def stats(self) -> dict:
-        with self._lat_lock:
-            lats = sorted(self._latencies)
         out = self.engine.stats()
+        e2e_ps = self.hist_e2e.percentiles((0.50, 0.95, 0.99))
         out.update({
             "requests_submitted": self.queue.submitted,
-            "p50_latency_s": round(_percentile(lats, 0.50), 4),
-            "p95_latency_s": round(_percentile(lats, 0.95), 4),
+            # the histogram windows are the ONE latency source of truth
+            # (the same samples /metrics exposes and latency_ms reads);
+            # one collect+sort per family covers every quantile below
+            "p50_latency_s": round(e2e_ps[0.50], 4),
+            "p95_latency_s": round(e2e_ps[0.95], 4),
+            # operator-facing percentiles off the sliding histogram
+            # windows (obs/registry.py) — until now these existed only
+            # inside bench sweeps, invisible to a running fleet
+            "latency_ms": {
+                "e2e": {f"p{int(q * 100)}": round(1e3 * e2e_ps[q], 3)
+                        for q in (0.50, 0.95, 0.99)},
+                "queue_wait": self.hist_queue_wait.percentiles_ms(),
+            },
             "postprocess_pending": (self.post.pending()
                                     if self.post is not None else 0),
         })
         return out
+
+    # -- /metrics (Prometheus text exposition) ------------------------------
+
+    # (stats_key, metric name, help) — counters are lifetime-monotonic
+    # engine/set counters; gauges are point-in-time. Keys absent from a
+    # given shape's stats (dense vs paged, single vs set) simply don't
+    # render — the catalog is the UNION, docs/OBSERVABILITY.md.
+    _COUNTER_METRICS = (
+        ("requests_submitted", "dalle_serve_requests_submitted_total",
+         "Requests accepted by the admission queue"),
+        ("completed", "dalle_serve_requests_completed_total",
+         "Requests decoded to completion"),
+        ("expired", "dalle_serve_requests_expired_total",
+         "Requests that exceeded their deadline (queued or decoding)"),
+        ("rejected", "dalle_serve_requests_rejected_total",
+         "Typed submit-time rejections (queue full / invalid / closed)"),
+        ("tokens_decoded", "dalle_serve_tokens_decoded_total",
+         "Distinct delivered image tokens (replay-safe accounting)"),
+        ("decode_steps", "dalle_serve_decode_steps_total",
+         "Fused decode steps dispatched (chunks x K)"),
+        ("harvests", "dalle_serve_harvests_total",
+         "Emit-ring device_gets (the only steady-state host syncs)"),
+        ("evicted", "dalle_serve_evicted_total",
+         "Paged-pool evictions (victims replay token-exact)"),
+        ("requeued", "dalle_serve_requeued_total",
+         "Requeues from eviction/page-defer/failover"),
+        ("prefix_hits", "dalle_serve_prefix_hits_total",
+         "Warm prefix-cache admissions (zero prefill FLOPs)"),
+        ("failovers", "dalle_serve_failovers_total",
+         "Replica fence+reclaim+replay cycles"),
+        ("reclaimed", "dalle_serve_reclaimed_total",
+         "Requests reclaimed from fenced replicas for replay"),
+        ("bringup_failures", "dalle_serve_bringup_failures_total",
+         "Replica bring-up attempts that failed (circuit breaker)"),
+        ("scale_outs", "dalle_serve_scale_outs_total",
+         "Elastic scale-out actions"),
+        ("scale_ins", "dalle_serve_scale_ins_total",
+         "Elastic scale-in actions"),
+        ("upgrades", "dalle_serve_upgrades_total",
+         "Completed rolling weight upgrades"),
+        ("profiles_taken", "dalle_serve_profiles_taken_total",
+         "Completed POST /admin/profile captures"),
+    )
+    _GAUGE_METRICS = (
+        ("queue_depth", "dalle_serve_queue_depth",
+         "Requests waiting in the admission queue(s)"),
+        ("active_slots", "dalle_serve_active_slots",
+         "Slots currently decoding"),
+        ("num_slots", "dalle_serve_num_slots",
+         "Total decode slots across live replicas"),
+        ("alive_replicas", "dalle_serve_alive_replicas",
+         "Replicas currently serving"),
+        ("replicas", "dalle_serve_replicas",
+         "Replicas in the set (retired excluded)"),
+        ("pages_in_use", "dalle_serve_pages_in_use",
+         "Physical KV pages mapped (shared pages counted once)"),
+        ("pages_free", "dalle_serve_pages_free",
+         "KV pages on the free list"),
+        ("kv_hbm_bytes", "dalle_serve_kv_hbm_bytes",
+         "Resident HBM bytes of the KV store"),
+        ("postprocess_pending", "dalle_serve_postprocess_pending",
+         "Completions queued for VAE/CLIP postprocess"),
+        ("flight_events", "dalle_serve_flight_events",
+         "Records currently retained in the flight ring(s)"),
+        ("mean_occupancy", "dalle_serve_mean_occupancy",
+         "Mean busy slots per dispatched decode step"),
+        ("upgrading", "dalle_serve_upgrading",
+         "1 while a rolling upgrade owns the fleet"),
+        ("profile_active", "dalle_serve_profile_active",
+         "1 while a jax.profiler capture is in flight"),
+    )
+
+    def metrics_text(self) -> str:
+        """The ``GET /metrics`` page: counters/gauges projected from
+        the live /stats dicts (per-replica samples labeled
+        ``replica``/``weights_version``/``state``) plus the latency
+        histograms. Built per scrape — scrape cost is one stats() walk
+        and string assembly, no device syncs."""
+        stats = self.stats()
+        counters = [(name, help_text, [(None, stats[key])])
+                    for key, name, help_text in self._COUNTER_METRICS
+                    if stats.get(key) is not None]
+        gauges = [(name, help_text, [(None, stats[key])])
+                  for key, name, help_text in self._GAUGE_METRICS
+                  if stats.get(key) is not None]
+        # identity: which weights generation the fleet serves
+        version = stats.get("weights_version", self.weights_version)
+        gauges.append(("dalle_serve_info",
+                       "Serving identity (labels carry the facts)",
+                       [({"weights_version": version,
+                          "kv": str(stats.get("kv", "")),
+                          "isolation": str(stats.get("isolation",
+                                                     "thread"))}, 1)]))
+        per = stats.get("per_replica") or ()
+        if per:
+            def rep_samples(key):
+                return [({"replica": rec["replica"],
+                          "weights_version": rec.get("weights_version",
+                                                     ""),
+                          "state": rec.get("state", "")}, rec.get(key))
+                        for rec in per]
+            counters.append((
+                "dalle_serve_replica_tokens_decoded_total",
+                "Per-replica tokens decoded (live engines only)",
+                rep_samples("tokens_decoded")))
+            counters.append((
+                "dalle_serve_replica_completed_total",
+                "Per-replica completed requests",
+                rep_samples("completed")))
+            gauges.append((
+                "dalle_serve_replica_active_slots",
+                "Per-replica busy slots", rep_samples("active_slots")))
+            gauges.append((
+                "dalle_serve_replica_queued",
+                "Per-replica routed-but-not-decoding requests",
+                rep_samples("queued")))
+            gauges.append((
+                "dalle_serve_replica_up",
+                "1 while the replica is in the running state",
+                [({"replica": rec["replica"],
+                   "weights_version": rec.get("weights_version", "")},
+                  1 if rec.get("state") == "running" else 0)
+                 for rec in per]))
+        return self.registry.render(counters=counters, gauges=gauges)
+
+    # -- /debug/events (the flight recorder) --------------------------------
+
+    def debug_events(self) -> dict:
+        """Everything the flight recorder holds, one endpoint: the
+        set-level ring (scale/upgrade/autoscale lifecycle + fence
+        events with embedded victim dumps), per-replica rings, and the
+        last dump per fenced replica index."""
+        if self._is_set:
+            return self.engine.debug_events()
+        fl = getattr(self.engine, "flight", None)
+        return {"server": fl.dump() if fl is not None else [],
+                "replicas": {}, "fenced": {}}
+
+    # -- POST /admin/profile (serve-side jax.profiler capture) --------------
+
+    def profile(self, log_dir: Optional[str] = None, chunks: int = 8,
+                replica: int = 0) -> dict:
+        """Arm a ``jax.profiler`` capture over the next ``chunks`` fused
+        decode chunks of one engine (``Engine.request_profile``).
+        ``log_dir`` defaults to the server's ``profile_dir``
+        (``serve_dalle --profile_dir``); neither set is a typed
+        refusal. Process-isolated replicas are typed-refused too — the
+        child's programs run in another interpreter, where this
+        process's profiler cannot see."""
+        from dalle_pytorch_tpu.serve.engine import ProfileError
+        log_dir = log_dir or self.profile_dir
+        if not log_dir:
+            raise ProfileError(S.structured_event(
+                "serve_profile_reject", reason="no_profile_dir",
+                detail="pass 'dir' in the request body or start the "
+                       "server with --profile_dir"))
+        if self._is_set:
+            if self.engine.isolation == "process":
+                raise ProfileError(S.structured_event(
+                    "serve_profile_reject",
+                    reason="process_isolation",
+                    detail="a child-process engine's programs run in "
+                           "another interpreter; profile it from the "
+                           "worker host (isolation=thread supports "
+                           "in-server capture)"))
+            replica = int(replica)
+            if not 0 <= replica < len(self.engine.replicas) \
+                    or self.engine.replicas[replica].engine is None:
+                raise ProfileError(S.structured_event(
+                    "serve_profile_reject", reason="no_such_replica",
+                    replica=replica))
+            eng = self.engine.replicas[replica].engine
+        else:
+            eng = self.engine
+        with self._profile_arm_lock:
+            if self._is_set:
+                # jax.profiler is a PER-PROCESS singleton: in a thread-
+                # isolation set every replica engine shares it, so a
+                # capture on any sibling must 409 here — the sibling's
+                # own per-engine guard can't see it, and a second
+                # start_trace would crash that replica's decode step
+                for i, r in enumerate(self.engine.replicas):
+                    e = r.engine
+                    if e is not None and e is not eng \
+                            and e.profile_active():
+                        raise ProfileError(S.structured_event(
+                            "serve_profile_reject",
+                            reason="capture_active", replica=i))
+            rec = dict(eng.request_profile(str(log_dir), chunks=chunks))
+        rec["replica"] = int(replica) if self._is_set else 0
+        return rec
 
 
 # ---------------------------------------------------------------------------
@@ -494,6 +755,10 @@ def _result_body(result: S.Result) -> dict:
         # upgrade contract's caller-visible half (byte-identical per
         # version), so an HTTP client can audit a mid-upgrade mix
         body["weights_version"] = result.weights_version
+    if result.trace is not None:
+        # the span-timeline summary (obs/trace.py): where this
+        # request's milliseconds went, replay edges included
+        body["trace"] = result.trace
     if result.tokens is not None:
         body["tokens"] = [int(t) for t in result.tokens]
     if result.image is not None:
@@ -525,6 +790,14 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             self.end_headers()
             self.wfile.write(data)
 
+        def _send_text(self, code: int, text: str, ctype: str) -> None:
+            data = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_GET(self):
             if self.path == "/healthz":
                 # health must reflect the serving loop(s), not just
@@ -534,6 +807,18 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 self._send(200 if body["ok"] else 503, body)
             elif self.path == "/stats":
                 self._send(200, server.stats())
+            elif self.path == "/metrics":
+                # Prometheus text exposition (obs/registry.py): the
+                # scrape-able twin of /stats plus the latency
+                # histograms (docs/OBSERVABILITY.md metric catalog)
+                self._send_text(
+                    200, server.metrics_text(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path == "/debug/events":
+                # the flight recorder: last-N structured events + span
+                # records per replica, always on — the one endpoint a
+                # post-incident "why did p95 spike" starts from
+                self._send(200, server.debug_events())
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
@@ -572,9 +857,47 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": str(e)})
 
+        def _admin_profile(self):
+            """POST /admin/profile — authenticated serve-side profiler
+            capture: {"dir": ..., "chunks": K, "replica": i}, all
+            optional (``dir`` falls back to --profile_dir). 401 without
+            the admin token; 409 with the structured record while a
+            capture is already active (or the target can't be
+            profiled) — kernel tuning on a real chip is one curl away,
+            and two operators can't trample each other's traces."""
+            import hmac as _hmac
+
+            from dalle_pytorch_tpu.serve.engine import ProfileError
+            auth = self.headers.get("Authorization", "")
+            token = auth[7:] if auth.startswith("Bearer ") \
+                else (self.headers.get("X-Admin-Token") or "")
+            if not _hmac.compare_digest(token, server.admin_token):
+                self._send(401, {"error": "bad admin token"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(req, dict):
+                    raise ValueError(f"body must be a JSON object, "
+                                     f"got {type(req).__name__}")
+                rec = server.profile(
+                    log_dir=req.get("dir"),
+                    chunks=int(req.get("chunks", 8)),
+                    replica=int(req.get("replica", 0)))
+            except ProfileError as e:
+                self._send(409, e.record)
+                return
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": str(e)})
+                return
+            self._send(200, rec)
+
         def do_POST(self):
             if self.path == "/admin/scale":
                 self._admin_scale()
+                return
+            if self.path == "/admin/profile":
+                self._admin_profile()
                 return
             if self.path != "/generate":
                 self._send(404, {"error": f"no route {self.path}"})

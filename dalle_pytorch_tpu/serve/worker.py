@@ -298,13 +298,22 @@ def _run(spec: dict, conn, sender: _FrameSender, rx_seq: int) -> None:
     hb_interval = float(spec.get("heartbeat_interval_s", 0.05))
     idle_sleep = float(spec.get("idle_sleep_s", 0.002))
     last_hb = 0.0
+    flight_seq = 0      # ring increments already shipped to the parent
 
     def send_snapshot(kind: str, results=None,
                       compiling: bool = False) -> None:
-        nonlocal last_hb
+        nonlocal last_hb, flight_seq
         chunks = engine.decode_steps // engine.chunk_steps
         snap = ipc.engine_snapshot(engine, chunks, rss_mb(), compiling)
         payload = {"snap": snap}
+        # the flight ring's INCREMENTS ride every snapshot frame: the
+        # parent's mirror is therefore as fresh as the last frame that
+        # landed, which is exactly what a SIGKILL post-mortem can
+        # honestly have (spans stamped after the last frame die with
+        # this process — a consistent prefix, never a lie)
+        flight_seq, events = engine.flight.since(flight_seq)
+        if events:
+            payload["events"] = events
         if results is not None:
             payload["results"] = results
         sender.send(kind, payload)
@@ -377,8 +386,17 @@ def _run(spec: dict, conn, sender: _FrameSender, rx_seq: int) -> None:
         # must never arrive ahead of the result it counted.
         done = [rid for rid, h in open_handles.items() if h.done()]
         if done:
-            wires = [open_handles.pop(rid).result(timeout=0).to_wire()
-                     for rid in done]
+            wires = []
+            for rid in done:
+                h = open_handles.pop(rid)
+                w = h.result(timeout=0).to_wire()
+                if h.trace is not None:
+                    # the stand-in trace's spans go home with the
+                    # result — the parent merges them into the
+                    # caller's timeline (scheduler.RequestHandle
+                    # .from_wire seeded the same trace_id)
+                    w["spans"] = h.trace.wire_spans()
+                wires.append(w)
             for i in range(0, len(wires), ipc.HARVEST_BATCH):
                 batch = wires[i:i + ipc.HARVEST_BATCH]
                 if i + ipc.HARVEST_BATCH >= len(wires):

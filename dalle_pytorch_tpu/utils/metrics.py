@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Optional
 
@@ -55,8 +56,31 @@ class MetricsLogger:
         self.n_devices = n_devices
         self._t_last = time.perf_counter()
         self._units_since = 0
+        # one persistent handle behind one lock: the serving stack's
+        # threads (engine loops, postprocess, supervisors, autoscaler)
+        # all append structured events concurrently, and the old
+        # per-call open(..., "a") raced them — two interleaved
+        # buffered writes could tear a JSONL line. Flush per record
+        # keeps the file current for live tail readers.
+        self._lock = threading.Lock()
+        self._fh = None
         if self.path:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+
+    def _write(self, rec: dict) -> None:
+        if not self.path:
+            return
+        with self._lock:
+            if self._fh is None:
+                self._fh = open(self.path, "a")
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def step(self, step: int, loss: float, *, epoch: Optional[int] = None,
              units: int = 0, unit_name: str = "tokens", **extra) -> None:
@@ -88,16 +112,12 @@ class MetricsLogger:
             print(f"{head}step {step}  loss {rec['loss']:.6f}  "
                   f"{rec[f'{unit_name}_per_sec_per_chip']:.1f} "
                   f"{unit_name}/s/chip", flush=True)
-        if self.path:
-            with open(self.path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+        self._write(rec)
 
     def event(self, **fields) -> None:
         """Free-form record (epoch summaries, checkpoint writes...)."""
         rec = {"time": time.time(), **fields}  # jaxlint: disable=JL007 — epoch stamp
-        if self.path:
-            with open(self.path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+        self._write(rec)
 
     def resilience(self, kind: str, **fields) -> None:
         """Structured failure/retry/rollback record — echoed to stdout
@@ -108,6 +128,4 @@ class MetricsLogger:
             detail = {k: v for k, v in rec.items()
                       if k not in ("time", "event")}
             print(f"[resilience] {json.dumps(detail)}", flush=True)
-        if self.path:
-            with open(self.path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+        self._write(rec)

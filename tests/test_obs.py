@@ -1,0 +1,647 @@
+"""Observability-layer tests (ISSUE 15 acceptance criteria).
+
+The load-bearing ones: per-request trace spans TILE (their durations sum
+back to the caller-observed latency), survive the socket transport
+byte-faithfully, stay transfer-clean in the steady state, and link a
+failover replay to the original trace with a visible ``replayed_from``
+gap — with the victim's flight-recorder dump embedded in the fence event
+(parent-side mirror, so a SIGKILL cannot destroy it). Plus the /metrics
+exposition (histogram counts == distinct delivered requests), the
+/debug/events surface, the typed /admin/profile 409, and the
+MetricsLogger thread-safety fix (concurrent appends, zero torn lines).
+
+All CPU, tiny model (total_len 24) so the file stays cheap inside
+tier-1; the one process+socket test is the SIGKILL acceptance row.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.analysis import guards
+from dalle_pytorch_tpu.models import dalle as D
+from dalle_pytorch_tpu.models import vae as V
+from dalle_pytorch_tpu.obs.flight import FlightRecorder, RecordingMetrics
+from dalle_pytorch_tpu.obs.registry import (Histogram, LabeledHistogram,
+                                            Registry)
+from dalle_pytorch_tpu.obs.trace import Trace, new_trace_id
+from dalle_pytorch_tpu.resilience import faults
+from dalle_pytorch_tpu.resilience.retry import RetryPolicy
+from dalle_pytorch_tpu.serve import (OK, Request, RequestHandle,
+                                     RequestQueue, SamplingParams)
+from dalle_pytorch_tpu.serve.engine import Engine, ProfileError
+
+VCFG = V.VAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                   num_layers=2, hidden_dim=8)
+CFG = D.DALLEConfig(dim=16, depth=2, vae=VCFG, num_text_tokens=50,
+                    text_seq_len=8, heads=2, dim_head=8)
+
+FAST_BRINGUP = RetryPolicy(max_attempts=1, deadline_s=None,
+                           base_backoff_s=0.01, backoff_multiplier=2.0,
+                           max_backoff_s=0.1, jitter=0.0)
+
+REQS = [
+    Request(codes=(3, 7, 9), seed=11),
+    Request(codes=(5, 2, 8, 1, 4), seed=23,
+            sampling=SamplingParams(temperature=0.7, filter_thres=0.8)),
+    Request(codes=(6, 6), seed=5,
+            sampling=SamplingParams(temperature=1.3, top_p=0.9)),
+    Request(codes=(2, 4, 4), seed=7),
+    Request(codes=(1, 5), seed=13),
+    Request(codes=(4, 4, 4, 4), seed=17),
+]
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    key = jax.random.PRNGKey(0)
+    vae_params = V.vae_init(jax.random.fold_in(key, 1), VCFG)
+    params = D.dalle_init(key, CFG, vae_params)
+    return params, vae_params
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# obs/trace.py
+# ---------------------------------------------------------------------------
+
+class TestTrace:
+    def test_spans_tile_and_sum(self):
+        tr = Trace(new_trace_id(7), 7, t0=100.0)
+        tr.span("submit", 100.0)
+        tr.span("queue_wait", 100.5)
+        tr.span("prefill_admit", 100.75, bucket=4, mode="cold")
+        tr.span("decode_chunk", 101.0, tokens=4)
+        tr.span("decode_chunk", 101.25, tokens=4)
+        s = tr.summary()
+        assert s["request_id"] == 7 and s["attempts"] == 1
+        names = [x["name"] for x in s["spans"]]
+        assert names == ["submit", "queue_wait", "prefill_admit",
+                         "decode_chunk"]
+        # tiling: the sum of durations IS the wall interval
+        assert s["span_total_s"] == pytest.approx(1.25)
+        chunk = next(x for x in s["spans"]
+                     if x["name"] == "decode_chunk")
+        assert chunk["n"] == 2 and chunk["total_s"] == pytest.approx(0.5)
+
+    def test_replay_marker_covers_the_gap_visibly(self):
+        """The fence gap is a LABELED span, not fabricated decode time
+        and not a hole: the replayed_from marker's duration is the gap,
+        so span sums still tile while the timeline shows the fence."""
+        tr = Trace("t", 1, t0=0.0)
+        tr.span("queue_wait", 0.1)
+        tr.span("decode_chunk", 0.4, tokens=4)
+        rec = tr.replay(1.4, reason="crash: boom", replica=1)
+        assert rec["span"] == "replayed_from"
+        assert rec["dur_s"] == pytest.approx(1.0)       # the gap
+        assert rec["from_attempt"] == 0 and rec["attempt"] == 1
+        tr.span("queue_wait", 1.5)
+        tr.span("decode_chunk", 2.0, tokens=8)
+        s = tr.summary()
+        assert s["attempts"] == 2
+        assert s["replays"] == [{"from_attempt": 0,
+                                 "reason": "crash: boom",
+                                 "gap_s": pytest.approx(1.0)}]
+        assert s["span_total_s"] == pytest.approx(2.0)
+
+    def test_has_in_attempt_resets_per_attempt(self):
+        tr = Trace("t", 1, t0=0.0)
+        tr.span("queue_wait", 0.1)
+        assert tr.has_in_attempt("queue_wait")
+        tr.replay(0.2, reason="fence")
+        assert not tr.has_in_attempt("queue_wait")
+
+    def test_wire_spans_cross_the_frame_codec_byte_faithfully(self):
+        """Float timestamps/durations survive the JSON frame protocol
+        exactly (repr round-trip — the same rule Request.to_wire
+        relies on), so a child's spans merge bit-identical."""
+        from dalle_pytorch_tpu.serve import ipc
+        tr = Trace("abc-123", 9, t0=12345.678901234567)
+        tr.span("queue_wait", 12345.981234567891)
+        tr.span("decode_chunk", 12346.123456789012, tokens=3)
+        spans = tr.wire_spans()
+        frame = ipc.encode_frame(
+            ipc.HARVEST, {"results": [{"spans": spans}]}, seq=4)
+        kind, payload, seq = ipc.decode_frame(frame)
+        assert payload["results"][0]["spans"] == spans
+
+    def test_merge_wire_skips_malformed_and_reanchors(self):
+        tr = Trace("t", 1, t0=0.0)
+        tr.span("route", 0.5, replica=0)
+        n = tr.merge_wire(
+            [{"span": "queue_wait", "dur_s": 0.25, "t0": 0.5,
+              "attempt": 0, "event": "span"},
+             "garbage", {"nope": 1}, None], now=1.0)
+        assert n == 1
+        tr.span("postprocess", 1.5)
+        s = tr.summary()
+        assert [x["name"] for x in s["spans"]] == \
+            ["route", "queue_wait", "postprocess"]
+        assert s["spans"][-1]["total_s"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# obs/flight.py
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_bounded_ring_and_since(self):
+        fl = FlightRecorder(capacity=4)
+        for i in range(10):
+            fl.record({"i": i})
+        assert len(fl) == 4
+        assert [r["i"] for r in fl.dump()] == [6, 7, 8, 9]
+        assert [r["i"] for r in fl.tail(2)] == [8, 9]
+        seq, recs = fl.since(0)
+        assert seq == 10 and [r["i"] for r in recs] == [6, 7, 8, 9]
+        fl.record({"i": 10})
+        seq2, recs2 = fl.since(seq)
+        assert [r["i"] for r in recs2] == [10] and seq2 == 11
+
+    def test_recording_metrics_tees_and_forwards(self):
+        fl = FlightRecorder(capacity=8)
+
+        class Sink:
+            events: list = []
+
+            def event(self, **f):
+                self.events.append(f)
+
+        sink = Sink()
+        m = RecordingMetrics(fl, sink)
+        m.event(event="resilience", kind="x", a=1)
+        assert fl.dump()[0]["kind"] == "x"
+        assert sink.events[0]["a"] == 1
+        # no sink: the ring still records (always-on is the point)
+        m2 = RecordingMetrics(FlightRecorder(4), None)
+        m2.event(kind="y")
+        assert m2.flight.dump()[0]["kind"] == "y"
+
+    def test_wrap_never_chains_rings(self):
+        from dalle_pytorch_tpu.obs.flight import wrap_metrics
+        base = object()
+        inner = RecordingMetrics(FlightRecorder(4), base)
+        outer = wrap_metrics(FlightRecorder(4), inner)
+        assert outer.inner is base
+
+
+# ---------------------------------------------------------------------------
+# obs/registry.py
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_histogram_buckets_count_sum_percentile(self):
+        h = Histogram(buckets=(0.1, 1.0), window=100)
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        assert h.count == 3 and h.sum == pytest.approx(5.55)
+        assert h.counts == [1, 1, 1]
+        assert h.percentile(0.5) == pytest.approx(0.5)
+        assert h.percentile(0.99) == pytest.approx(5.0)
+
+    def test_labeled_histogram_renders_prometheus_text(self):
+        reg = Registry()
+        lh = reg.histogram("x_seconds", "help text", buckets=(0.1, 1.0))
+        lh.observe(0.05, weights_version="v1")
+        lh.observe(0.5, weights_version="v2")
+        text = reg.render()
+        assert "# TYPE x_seconds histogram" in text
+        assert 'x_seconds_bucket{le="0.1",weights_version="v1"} 1' \
+            in text
+        assert 'x_seconds_bucket{le="+Inf",weights_version="v1"} 1' \
+            in text
+        assert 'x_seconds_count{weights_version="v2"} 1' in text
+        assert lh.total_count() == 2
+        # merged percentiles across children (the /stats surface)
+        p = lh.percentiles_ms()
+        assert p["p50"] == pytest.approx(50.0) \
+            or p["p50"] == pytest.approx(500.0)
+
+    def test_counters_gauges_and_escaping(self):
+        reg = Registry()
+        text = reg.render(
+            counters=[("c_total", "a counter",
+                       [({"k": 'we"ird\nvalue\\x'}, 3)])],
+            gauges=[("g", "a gauge", [(None, 1.5)]),
+                    ("empty", "dropped", [])])
+        assert "# TYPE c_total counter" in text
+        assert 'c_total{k="we\\"ird\\nvalue\\\\x"} 3' in text
+        assert "g 1.5" in text
+        assert "empty" not in text      # no samples -> no headers
+
+    def test_bad_metric_name_rejected(self):
+        with pytest.raises(ValueError):
+            Registry().histogram("9bad-name", "x")
+
+
+# ---------------------------------------------------------------------------
+# utils.metrics.MetricsLogger thread-safety (satellite)
+# ---------------------------------------------------------------------------
+
+class TestMetricsLoggerConcurrency:
+    def test_concurrent_events_no_torn_lines(self, tmp_path):
+        from dalle_pytorch_tpu.utils.metrics import MetricsLogger
+        path = tmp_path / "m.jsonl"
+        m = MetricsLogger(str(path))
+        n_threads, n_events = 8, 200
+
+        def spam(tid):
+            for i in range(n_events):
+                m.event(event="serve", tid=tid, i=i,
+                        pad="x" * 64)      # wide enough to tear
+
+        threads = [threading.Thread(target=spam, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        m.close()
+        lines = path.read_text().splitlines()
+        assert len(lines) == n_threads * n_events
+        seen = set()
+        for line in lines:
+            rec = json.loads(line)      # a torn line would fail here
+            seen.add((rec["tid"], rec["i"]))
+        assert len(seen) == n_threads * n_events
+
+
+# ---------------------------------------------------------------------------
+# engine-level tracing
+# ---------------------------------------------------------------------------
+
+class TestEngineTracing:
+    def test_trace_rides_result_and_sums_to_latency(self, bundle):
+        params, _ = bundle
+        queue = RequestQueue(max_depth=8)
+        engine = Engine(params, CFG, queue, num_slots=2, chunk_steps=4)
+        handles = [queue.submit(r) for r in REQS[:3]]
+        engine.run_until_idle()
+        for h in handles:
+            res = h.result(timeout=5)
+            assert res.status == OK
+            tr = res.trace
+            assert tr is not None and tr["attempts"] == 1
+            names = [s["name"] for s in tr["spans"]]
+            assert names[:3] == ["submit", "queue_wait",
+                                 "prefill_admit"]
+            assert "decode_chunk" in names
+            # tiling: single-process spans sum EXACTLY to the
+            # caller-observed latency (same clock, no process gaps;
+            # total_s rounds to 6 places)
+            assert tr["span_total_s"] == pytest.approx(res.total_s,
+                                                       abs=2e-5)
+            chunk = next(s for s in tr["spans"]
+                         if s["name"] == "decode_chunk")
+            assert chunk["n"] == engine.harvests or chunk["n"] >= 1
+
+    def test_span_stamping_is_transfer_clean(self, bundle):
+        """The tracing layer adds ZERO host<->device traffic: the full
+        steady-state iteration — admission, chunk dispatch, emit-ring
+        harvest, span stamps, flight-ring appends — runs under
+        guards.no_transfers, the same contract the pre-obs engine
+        pinned."""
+        params, _ = bundle
+        queue = RequestQueue(max_depth=8)
+        engine = Engine(params, CFG, queue, num_slots=2, chunk_steps=4)
+        # warm run compiles the decode program + both buckets
+        for r in REQS[:2]:
+            queue.submit(r)
+        engine.run_until_idle()
+        with guards.no_transfers():
+            handles = [queue.submit(r) for r in REQS[:2]]
+            engine.run_until_idle()
+        for h in handles:
+            res = h.result(timeout=5)
+            assert res.status == OK and res.trace is not None
+            assert any(s["name"] == "decode_chunk"
+                       for s in res.trace["spans"])
+
+    def test_spans_and_events_land_in_flight_ring(self, bundle):
+        params, _ = bundle
+        queue = RequestQueue(max_depth=8)
+        engine = Engine(params, CFG, queue, num_slots=2, chunk_steps=4)
+        h = queue.submit(REQS[0])
+        engine.run_until_idle()
+        assert h.result(timeout=5).status == OK
+        # (the zero-dur submit marker is stamped by the QUEUE, which
+        # has no ring — it reaches /debug/events via the trace dumps)
+        kinds = {r.get("span") for r in engine.flight.dump()
+                 if r.get("event") == "span"}
+        assert {"queue_wait", "prefill_admit", "decode_chunk"} <= kinds
+        assert engine.stats()["flight_events"] == len(engine.flight)
+
+    def test_profile_409_while_active_and_completes(self, bundle,
+                                                    tmp_path):
+        params, _ = bundle
+        queue = RequestQueue(max_depth=8)
+        engine = Engine(params, CFG, queue, num_slots=2, chunk_steps=4)
+        rec = engine.request_profile(str(tmp_path / "prof"), chunks=2)
+        assert rec["kind"] == "serve_profile_armed"
+        with pytest.raises(ProfileError) as ei:
+            engine.request_profile(str(tmp_path / "other"), chunks=1)
+        assert ei.value.record["reason"] == "capture_active"
+        queue.submit(REQS[0])
+        engine.run_until_idle()
+        assert not engine.profile_active()
+        assert engine.profiles_taken == 1
+        assert any((tmp_path / "prof").iterdir())
+        # re-armable once the capture completed
+        engine.request_profile(str(tmp_path / "prof2"), chunks=1)
+
+
+# ---------------------------------------------------------------------------
+# replica-set tracing: thread-mode failover replay link
+# ---------------------------------------------------------------------------
+
+class TestReplicaTracing:
+    pytestmark = pytest.mark.faults
+
+    def test_thread_crash_yields_linked_replay_trace(self, bundle):
+        from dalle_pytorch_tpu.serve.replica import ReplicaSet
+        params, _ = bundle
+        queue = RequestQueue(max_depth=16)
+        with faults.injected(fault_replica=1, replica_crash_at_chunk=2):
+            rs = ReplicaSet(params, CFG, queue, replicas=2, num_slots=2,
+                            chunk_steps=4, bringup_policy=FAST_BRINGUP)
+            handles = [queue.submit(r) for r in REQS]
+            rs.run_until_idle(max_steps=500_000)
+        assert rs.failovers == 1
+        traces = [h.result(timeout=5).trace for h in handles]
+        assert all(t is not None for t in traces)
+        replayed = [t for t in traces if t["replays"]]
+        assert replayed, "the crash replayed nothing?"
+        for t in replayed:
+            assert t["attempts"] >= 2
+            assert "crash" in t["replays"][0]["reason"]
+            # the gap is visible AND the sums still tile
+            assert any(s["name"] == "replayed_from"
+                       for s in t["spans"])
+            res = next(h.result(timeout=0) for h in handles
+                       if h.result(timeout=0).trace is t)
+            assert t["span_total_s"] == pytest.approx(res.total_s,
+                                                      abs=2e-5)
+        # routed requests carry the router's spans
+        assert any(s["name"] == "route"
+                   for t in traces for s in t["spans"])
+        # the fence event embedded the victim's flight dump, and the
+        # set-level /debug surface serves it
+        dump = rs.debug_events()
+        fences = [e for e in dump["server"]
+                  if e.get("kind") == "serve_replica_fenced"]
+        assert fences and fences[0].get("flight"), \
+            "fence event carries no flight dump"
+        assert any(e.get("event") == "span"
+                   for e in fences[0]["flight"])
+        assert dump["fenced"], "no fenced-replica dump retained"
+
+    def test_scale_error_embeds_flight_tail(self, bundle):
+        from dalle_pytorch_tpu.serve.replica import ReplicaSet, ScaleError
+        params, _ = bundle
+        queue = RequestQueue(max_depth=8)
+        rs = ReplicaSet(params, CFG, queue, replicas=1, num_slots=2,
+                        chunk_steps=4, bringup_policy=FAST_BRINGUP)
+        with pytest.raises(ScaleError) as ei:
+            rs.remove_replica(0)
+        assert isinstance(ei.value.record.get("flight"), list)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance row: process+socket SIGKILL -> linked trace + dumps
+# ---------------------------------------------------------------------------
+
+class TestProcessObsAcceptance:
+    pytestmark = pytest.mark.faults
+
+    def test_sigkill_linked_trace_and_flight_dump_socket(self, bundle):
+        """A process+socket 2-replica run with a mid-decode SIGKILL:
+        the victim's flight-recorder dump (parent-side mirror — the
+        corpse answers nothing), a replayed trace linked to the
+        original trace_id whose span durations sum to the caller-
+        observed latency within one harvest chunk of slop, and zero
+        requests lost."""
+        import time as _time
+
+        from dalle_pytorch_tpu.serve.replica import RUNNING, ReplicaSet
+
+        def wait_all_ready(rs, timeout=180.0):
+            # same deflake as test_replica's helper: children come up
+            # seconds apart, and the first-ready replica's admission
+            # window could swallow the burst before the fault target
+            # ever decodes a chunk
+            deadline = _time.perf_counter() + timeout
+            while _time.perf_counter() < deadline:
+                rs.step_once()
+                live = [r for r in rs.replicas if r.state == RUNNING
+                        and r.engine is not None]
+                if len(live) == rs.n_replicas and all(
+                        getattr(r.engine, "ready", True) for r in live):
+                    return
+                _time.sleep(0.01)
+            raise AssertionError("replicas never all became ready")
+        params, _ = bundle
+        queue = RequestQueue(max_depth=16)
+        with faults.injected(fault_replica=1,
+                             replica_sigkill_at_chunk=2):
+            rs = ReplicaSet(params, CFG, queue, replicas=2, num_slots=2,
+                            chunk_steps=4, isolation="process",
+                            transport="socket",
+                            bringup_policy=FAST_BRINGUP)
+            try:
+                wait_all_ready(rs)
+                t_submit = _time.perf_counter()
+                handles = [queue.submit(r) for r in REQS]
+                rs.run_until_idle(max_steps=500_000)
+                assert rs.failovers == 1
+                wall = _time.perf_counter() - t_submit
+                results = [h.result(timeout=10) for h in handles]
+                assert all(r.status == OK for r in results), \
+                    [(r.status, r.reason) for r in results]
+                traces = [r.trace for r in results]
+                assert all(t is not None for t in traces)
+                # original trace ids survive the replay: attempts > 1
+                # under the SAME trace_id, linked by replayed_from
+                replayed = [t for t in traces if t["replays"]]
+                assert replayed, "the SIGKILL replayed nothing?"
+                for t in replayed:
+                    assert t["attempts"] >= 2
+                    assert any(s["name"] == "replayed_from"
+                               for s in t["spans"])
+                # span sums reconstruct caller latency: cross-process
+                # tiling leaves only IPC-absorb gaps, bounded by one
+                # harvest chunk of slop per attempt
+                for r in results:
+                    t = r.trace
+                    assert 0 < t["span_total_s"] <= r.total_s + 1e-4
+                    assert r.total_s - t["span_total_s"] \
+                        < 0.5 * wall + 0.25, (t, r.total_s)
+                # child-side spans crossed the socket and merged
+                assert any(s["name"] == "decode_chunk"
+                           for t in traces for s in t["spans"])
+                # the victim's mirror dump: embedded in the fence
+                # event AND retained under fenced[]
+                dump = rs.debug_events()
+                fences = [e for e in dump["server"]
+                          if e.get("kind") == "serve_replica_fenced"]
+                assert fences
+                victim = fences[0].get("flight")
+                assert victim, "SIGKILL destroyed the flight dump?"
+                assert any(e.get("event") == "span" for e in victim), \
+                    "no spans survived in the parent-side mirror"
+                assert dump["fenced"].get("1") is not None
+            finally:
+                rs.close()
+
+
+# ---------------------------------------------------------------------------
+# server surface: /metrics, /debug/events, /admin/profile over HTTP
+# ---------------------------------------------------------------------------
+
+class TestServerObs:
+    @pytest.fixture()
+    def server(self, bundle, tmp_path):
+        from dalle_pytorch_tpu.serve.server import (InferenceServer,
+                                                    make_http_server)
+        params, vae_params = bundle
+        srv = InferenceServer(params, vae_params, CFG, num_slots=2,
+                              chunk_steps=4, decode_images=False,
+                              profile_dir=str(tmp_path / "prof"))
+        srv.start()
+        httpd = make_http_server(srv, port=0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            yield srv, port
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            srv.close()
+
+    @staticmethod
+    def _get(port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, r.read().decode()
+
+    @staticmethod
+    def _post(port, path, body, token=None):
+        headers = {}
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode(), method="POST",
+            headers=headers)
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_metrics_stats_debug_and_profile(self, server):
+        srv, port = server
+        for i, r in enumerate(REQS[:3]):
+            res = srv.submit(r.codes, seed=r.seed).result(timeout=60)
+            assert res.ok
+        # /stats: operator latency percentiles off the histogram window
+        stats = srv.stats()
+        lat = stats["latency_ms"]
+        assert lat["e2e"]["p50"] > 0
+        assert set(lat["queue_wait"]) == {"p50", "p95", "p99"}
+        # /metrics: required families + count == delivered requests
+        st, text = self._get(port, "/metrics")
+        assert st == 200
+        for fam in ("dalle_serve_requests_submitted_total",
+                    "dalle_serve_requests_completed_total",
+                    "dalle_serve_tokens_decoded_total",
+                    "dalle_serve_queue_depth",
+                    "dalle_serve_e2e_latency_seconds_bucket",
+                    "dalle_serve_queue_wait_seconds_count",
+                    "dalle_serve_decode_ms_per_token_count",
+                    "dalle_serve_info"):
+            assert fam in text, f"missing family {fam}"
+        count = [ln for ln in text.splitlines()
+                 if ln.startswith("dalle_serve_e2e_latency_seconds_"
+                                  "count")]
+        assert count and count[0].split()[-1] == "3", count
+        # the prefill family is fed from the trace summary, which must
+        # exist BEFORE the on_fulfill hook runs (regression: it was
+        # attached only later, inside handle.fulfill, leaving the
+        # family headers-only forever)
+        pre = [ln for ln in text.splitlines()
+               if ln.startswith("dalle_serve_prefill_seconds_count")]
+        assert pre and int(pre[0].split()[-1]) == 3, pre
+        # /debug/events: span records served with no sink configured
+        st, body = self._get(port, "/debug/events")
+        events = json.loads(body)["server"]
+        assert any(e.get("event") == "span" for e in events)
+        # HTTP result bodies carry the trace summary
+        st, gen = self._post(port, "/generate",
+                             {"codes": [1, 2], "seed": 3})
+        assert st == 200 and "trace" in gen \
+            and gen["trace"]["span_total_s"] > 0
+        # /admin/profile: 401 unauthenticated, 200 armed, 409 active
+        st, _ = self._post(port, "/admin/profile", {})
+        assert st == 401
+        st, rec = self._post(port, "/admin/profile", {"chunks": 500},
+                             token=srv.admin_token)
+        assert st == 200 and rec["kind"] == "serve_profile_armed"
+        st, rec = self._post(port, "/admin/profile", {"chunks": 1},
+                             token=srv.admin_token)
+        assert st == 409 and rec["reason"] == "capture_active"
+
+    def test_profile_thread_set_guard_is_process_wide(self, bundle,
+                                                      tmp_path):
+        """jax.profiler is one trace per PROCESS: in a thread-isolation
+        replica set a capture on any replica must 409 arms targeting
+        its siblings — a second start_trace would crash the sibling's
+        decode step mid-request."""
+        import time as _time
+
+        from dalle_pytorch_tpu.serve.server import InferenceServer
+        params, vae_params = bundle
+        srv = InferenceServer(params, vae_params, CFG, num_slots=2,
+                              chunk_steps=4, decode_images=False,
+                              replicas=2,
+                              profile_dir=str(tmp_path / "prof"))
+        srv.start()
+        try:
+            deadline = _time.perf_counter() + 120.0
+            while _time.perf_counter() < deadline:
+                if all(r.engine is not None
+                       for r in srv.engine.replicas):
+                    break
+                _time.sleep(0.05)
+            rec = srv.profile(replica=0)
+            assert rec["kind"] == "serve_profile_armed"
+            with pytest.raises(ProfileError) as ei:
+                srv.profile(replica=1)
+            assert ei.value.record["reason"] == "capture_active"
+            assert ei.value.record["replica"] == 0
+        finally:
+            srv.close()
+
+    def test_profile_without_dir_typed_reject(self, bundle):
+        from dalle_pytorch_tpu.serve.server import InferenceServer
+        params, vae_params = bundle
+        srv = InferenceServer(params, vae_params, CFG, num_slots=2,
+                              decode_images=False)
+        try:
+            with pytest.raises(ProfileError) as ei:
+                srv.profile()
+            assert ei.value.record["reason"] == "no_profile_dir"
+        finally:
+            srv.close()
