@@ -2344,6 +2344,109 @@ def _serve_elastic_compare(params, cfg, *, num_slots, chunk_steps=8):
         rs.close()
 
 
+def _serve_migrate_compare(params, cfg, *, num_slots, page_size,
+                           chunk_steps=8):
+    """The live-migration headline (docs/SERVING.md 'Live migration &
+    disaggregated roles'): two identical 2-replica paged runs that both
+    retire replica 0 with its requests MID-STREAM. The migrated leg
+    (``remove_replica(drain=True)``) ships each in-flight request's KV
+    pages + decode cursor to the survivor, which finishes it without
+    re-decoding a token; the replay leg (``drain=False``) takes the
+    pre-migration path — fence, reclaim, re-decode from token zero.
+    Both legs must complete every request ("zero loss" is table
+    stakes either way — replay already guaranteed it); the tokens of
+    the two legs must be byte-identical (migration changes WHERE the
+    remaining tokens decode, never WHAT they are); and the migrated
+    leg's ``migrated_tokens_saved`` must cover at least half the
+    tokens the replay leg re-decoded — the whole point of the
+    feature, asserted so CI's serve-migrate smoke greps one "error"
+    field."""
+    from dalle_pytorch_tpu.serve import Request, RequestQueue, \
+        SamplingParams
+    from dalle_pytorch_tpu.serve.replica import ReplicaSet
+
+    prompt_len = min(4, cfg.text_seq_len)
+    n_req = 2 * max(2, num_slots // 2)
+    # a full harvest chunk per victim request before the removal: the
+    # migration must move requests that are deep enough into decode
+    # that replaying them from zero is visibly wasteful
+    min_prog = max(2, chunk_steps)
+
+    def leg(drain, tag):
+        queue = RequestQueue(max_depth=256)
+        rs = ReplicaSet(params, cfg, queue, replicas=2,
+                        num_slots=num_slots, chunk_steps=chunk_steps,
+                        kv="paged", page_size=page_size,
+                        weights_version="v1")
+        try:
+            handles = [queue.submit(Request(
+                codes=(1 + i % 7,) * prompt_len, seed=i,
+                sampling=SamplingParams())) for i in range(n_req)]
+            vic = rs.replicas[0]
+            deadline = time.perf_counter() + 120
+            prog = {}
+            while time.perf_counter() < deadline:
+                rs.step_once()
+                if all(h.done() for h in handles):
+                    raise AssertionError(
+                        f"migrate leg {tag!r}: every request finished "
+                        f"before the removal point — decode too short "
+                        f"to prove anything")
+                prog = vic.engine.progress_snapshot()
+                if prog and min(prog.values()) >= min_prog:
+                    break
+            else:
+                raise AssertionError(
+                    f"migrate leg {tag!r}: replica 0 never reached "
+                    f"{min_prog} tokens in-slot ({prog})")
+            pre_tokens = sum(prog.values())
+            saved0 = rs.migrated_tokens_saved
+            rs.remove_replica(0, drain=drain,
+                              reason=f"bench migrate_compare {tag}")
+            rs.run_until_idle(max_steps=2_000_000)
+            res = [h.result(timeout=120) for h in handles]
+            ok = sum(r.ok for r in res)
+            if ok != n_req:
+                raise AssertionError(
+                    f"migrate leg {tag!r} lost requests: {ok}/{n_req} "
+                    f"({[r.reason for r in res if not r.ok]})")
+            return {
+                "requests": n_req, "completed": ok,
+                "inflight_at_removal": len(prog),
+                "tokens_at_removal": pre_tokens,
+                "migrations": rs.migrations,
+                "migrate_fallbacks": rs.migrate_fallbacks,
+                "tokens_saved": rs.migrated_tokens_saved - saved0,
+            }, [None if r.tokens is None else [int(t) for t in r.tokens]
+                for r in res]
+        finally:
+            rs.close()
+
+    migrated, toks_m = leg(True, "migrated")
+    replay, toks_r = leg(False, "replay")
+    if toks_m != toks_r:
+        bad = sum(a != b for a, b in zip(toks_m, toks_r))
+        raise AssertionError(
+            f"migrated vs replayed tokens diverge on {bad}/{n_req} "
+            f"requests — migration must not change WHAT decodes")
+    if migrated["migrations"] < 1:
+        raise AssertionError(
+            f"the drain never migrated a request ({migrated})")
+    saved, replayed = migrated["tokens_saved"], \
+        replay["tokens_at_removal"]
+    if saved < max(1, replayed // 2):
+        raise AssertionError(
+            f"migration saved {saved} tokens vs {replayed} the replay "
+            f"leg re-decoded — under the 50% bar, the move is not "
+            f"paying for itself")
+    return {
+        "migrated": migrated, "replay": replay,
+        "tokens_identical": True,
+        "saved_vs_replayed_pct": round(100.0 * saved
+                                       / max(replayed, 1), 1),
+    }
+
+
 def _serve_mesh_compare(params, cfg, *, mesh_devices, num_slots, n_req,
                         kv, page_size, chunk_steps=8):
     """The mesh-sharded engine record (docs/SERVING.md 'Mesh-sharded
@@ -2726,6 +2829,18 @@ def bench_serve(args):
             elastic_compare = {"error": f"{type(e).__name__}: {e}"}
             errors.append(str(e))
 
+    migration_compare = None
+    if args.serve_migrate:
+        _progress("serve: live-migration vs replay-from-zero "
+                  "comparison (zero-loss + byte-identity asserted)")
+        try:
+            migration_compare = _serve_migrate_compare(
+                params, cfg, num_slots=num_slots, page_size=page_size)
+        except Exception as e:  # noqa: BLE001 — structured-error
+            # contract: the serve-migrate CI leg greps for it
+            migration_compare = {"error": f"{type(e).__name__}: {e}"}
+            errors.append(str(e))
+
     best = k_sweep[-1]["results"][-1]
     record = {
         "metric": "serve engine offered-load sweep (device-resident "
@@ -2754,6 +2869,8 @@ def bench_serve(args):
         record["transport_compare"] = transport_compare
     if elastic_compare is not None:
         record["elastic_compare"] = elastic_compare
+    if migration_compare is not None:
+        record["migration_compare"] = migration_compare
     if errors:
         record["error"] = "; ".join(errors)
     return record
@@ -2908,6 +3025,18 @@ def main():
                          "scales back in — zero lost requests and "
                          "per-phase weights_version counts asserted "
                          "(docs/SERVING.md 'Elastic fleet')")
+    ap.add_argument("--serve_migrate", action="store_true",
+                    help="bench_serve: run the migration_compare leg — "
+                         "two identical 2-replica paged runs retiring "
+                         "replica 0 mid-stream, one via live KV "
+                         "migration (the survivor finishes each moved "
+                         "request without re-decoding a token), one "
+                         "via the replay-from-zero fallback; zero "
+                         "losses both legs, byte-identical tokens "
+                         "across legs, and migrated_tokens_saved >= "
+                         "50% of what replay re-decoded, all asserted "
+                         "(docs/SERVING.md 'Live migration & "
+                         "disaggregated roles')")
     ap.add_argument("--transport", choices=("pipe", "socket"),
                     default="pipe",
                     help="bench_serve with --isolation process: "

@@ -143,6 +143,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "survivor with bit-identical tokens — zero "
                         "requests lost (docs/SERVING.md 'Replica set & "
                         "failover')")
+    p.add_argument("--replica_roles", type=str, default="",
+                   help="comma list of per-replica roles, one per "
+                        "--replicas entry (prefill|decode|both; e.g. "
+                        "'prefill,decode'): disaggregated serving. A "
+                        "'prefill' replica admits and prefills new "
+                        "requests, then LIVE-MIGRATES each warm request "
+                        "— its mapped KV pages, block table, and decode "
+                        "cursor — to a 'decode' replica, which carries "
+                        "it to completion byte-identical; decode "
+                        "replicas are routed new work only when no "
+                        "prefill-capable replica has capacity. Roles "
+                        "are a routing preference, not a capability "
+                        "wall: zero-loss always outranks the role "
+                        "split. Requires --kv paged (docs/SERVING.md "
+                        "'Live migration & disaggregated roles')")
     p.add_argument("--mesh_devices", type=int, default=1,
                    help="devices per engine: >1 serves ONE logical "
                         "engine pjit-sharded over an ICI mesh slice of "
@@ -397,6 +412,8 @@ def main(argv=None):
         prefix_cache=args.prefix_cache,
         default_cfg_scale=args.cfg_scale,
         replicas=args.replicas, mesh_devices=args.mesh_devices,
+        replica_roles=(args.replica_roles.split(",")
+                       if args.replica_roles else None),
         weights_version=f"{args.name}_dalle@{args.dalle_epoch}",
         # the documented default: --max_replicas 0 means NO runtime
         # growth beyond --replicas, not "uncapped" — cap at the
@@ -426,6 +443,8 @@ def main(argv=None):
         kv_desc += f", cfg_scale={args.cfg_scale:g}"
     iso_desc = args.isolation if args.transport == "pipe" \
         else f"{args.isolation}/{args.transport}"
+    if args.replica_roles:
+        iso_desc += f" [{args.replica_roles}]"
     mesh_desc = "" if args.mesh_devices <= 1 \
         else f" x {args.mesh_devices}-device mesh"
     say(f"serving {dalle_path} on http://{args.host}:{args.port} "

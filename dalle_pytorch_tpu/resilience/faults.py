@@ -35,6 +35,14 @@ Simulated faults (pytest -m faults exercises each):
       an unplanned death; reclaim-from-shadow still loses nothing), and
       a canary that fails the upgrade health gate (typed UpgradeAborted
       + rollback, fleet left on the old version).
+  * LIVE-MIGRATION faults               -> on_migrate_transfer /
+      on_migrate_import
+      the source replica SIGKILLed at the instant its slot snapshot is
+      requested (the export times out against the corpse and every
+      request it held replays from the parent's shadow — zero loss),
+      and the target rejecting the import with page exhaustion (the
+      supervisor falls back to the next target or replay; the request
+      completes byte-identically either way).
   * NETWORK faults (socket transport)   -> on_worker_chunk
       connection reset mid-frame (RST after half a frame), torn frame
       (half a frame then FIN), stalled socket (open but silent),
@@ -149,6 +157,25 @@ class FaultPlan:
     scale_add_bringup_crash: int = 0
     upgrade_drain_sigkill_replica: int = -1
     upgrade_canary_fail_replica: int = -1
+    # LIVE-MIGRATION faults (serve/replica.py's _migrate_from): the two
+    # rungs of the migrate->replay fallback ladder, each of which must
+    # degrade to deterministic replay with zero requests lost:
+    #   * migrate_crash_source_at_transfer: real SIGKILL of the SOURCE
+    #     replica's child at the instant the supervisor requests its
+    #     slot snapshot — the export times out against a corpse, the
+    #     target never sees a frame (nothing partial to discard), and
+    #     everything the source held replays from the parent's shadow
+    #     (process isolation only: a thread cannot survive its own
+    #     SIGKILL, the hook raises FaultInjected on a thread set, which
+    #     the supervisor converts to the same fallback);
+    #   * migrate_reject_target: the TARGET replica reports page
+    #     exhaustion at import time — the supervisor must fall back to
+    #     replay (or the next target) and the request must complete
+    #     byte-identically anyway.
+    # Both name the replica INDEX to target; -1 = off, fire at most
+    # once per activation.
+    migrate_crash_source_at_transfer: int = -1
+    migrate_reject_target: int = -1
 
 
 _active: Optional[FaultPlan] = None
@@ -480,6 +507,48 @@ def on_upgrade_drain(replica: int, pid: Optional[int]) -> None:
     # expected a live replica (died-on-its-own, decoded exit SIGKILL),
     # not that our kill races the supervisor's own fence kill
     time.sleep(0.3)
+
+
+def on_migrate_transfer(replica: int, pid: Optional[int]) -> None:
+    """Called by the supervisor's ``_migrate_from`` just BEFORE it asks
+    ``replica`` (the migration SOURCE) for a slot snapshot: with
+    ``migrate_crash_source_at_transfer`` targeting it, deliver a REAL
+    SIGKILL to the source's child process — the export call then runs
+    against a corpse, times out typed (``MigrationError
+    'source_dead'``), and every request the source held must fall back
+    to shadow-reclaim replay with zero loss. Needs process isolation;
+    on a thread replica the hook raises ``FaultInjected`` instead of
+    passing vacuously, which the supervisor converts into the same
+    replay fallback."""
+    p = _active
+    if p is None or replica != p.migrate_crash_source_at_transfer \
+            or not _once("migrate_crash_source"):
+        return
+    if pid is None:
+        raise FaultInjected(
+            "migrate_crash_source_at_transfer fired but the replica "
+            "has no child process to kill — run with "
+            "isolation='process', or this fault proves nothing")
+    os.kill(pid, signal.SIGKILL)
+    # as with on_upgrade_drain: let the death become observable, so
+    # the export finds a corpse rather than racing the kill
+    time.sleep(0.3)
+
+
+def on_migrate_import(replica: int) -> None:
+    """Inside ``_migrate_from``'s import step, just before the snapshot
+    is offered to ``replica`` (the migration TARGET): with
+    ``migrate_reject_target`` naming it, simulate the target reporting
+    page-pool exhaustion — the supervisor must record the typed
+    fallback and the request must complete byte-identically via the
+    next target or deterministic replay."""
+    p = _active
+    if p is None or replica != p.migrate_reject_target \
+            or not _once("migrate_reject_target"):
+        return
+    raise FaultInjected(
+        f"injected migration target rejection (replica {replica}: "
+        f"page pool exhausted)")
 
 
 def on_canary_gate(replica: int, version: str) -> None:

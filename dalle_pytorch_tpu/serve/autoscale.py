@@ -248,6 +248,11 @@ class Autoscaler:
                      key=lambda r: r.index, default=None)
         if victim is None:
             return None
+        # remove_replica(drain=True) live-migrates the victim's
+        # in-flight work to survivors before the fence — the delta of
+        # the set's migrated_tokens_saved counter across the call is
+        # what this decision avoided re-decoding
+        saved0 = rs.migrated_tokens_saved
         try:
             reclaimed = rs.remove_replica(victim.index, drain=True,
                                           reason="autoscale scale-in")
@@ -258,6 +263,8 @@ class Autoscaler:
         self.last_action_t = now
         return self._record("scale_in", sig, replica=victim.index,
                             reclaimed=reclaimed,
+                            tokens_saved=rs.migrated_tokens_saved
+                            - saved0,
                             replicas=rs.n_replicas)
 
     # -- threaded drive -----------------------------------------------------

@@ -140,6 +140,50 @@ class ProfileError(RuntimeError):
         self.record = record
 
 
+class MigrationError(RuntimeError):
+    """Typed failure of a live slot migration (export or import) — the
+    signal that flips the replica set from "move the KV pages" to the
+    replay fallback (requeue + deterministic re-decode from token
+    zero), never a dropped request. ``reason`` is a short machine slug
+    (``kv_dense``, ``not_found``, ``fenced``, ``weights_version``,
+    ``page_size``, ``layout``, ``target_slots``, ``target_pages``,
+    ``source_dead``, ``target_dead``, ``transfer``) the structured
+    ``serve_migrate_fallback`` event carries."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"migration failed ({reason})"
+                         + (f": {detail}" if detail else ""))
+        self.reason = reason
+
+
+def _pack_array(a) -> dict:
+    """One host array as a JSON-safe dict (dtype/shape/base64 bytes) —
+    the page-snapshot wire form MIGRATE frames carry. Exact: raw bytes,
+    no float text round-trip."""
+    import base64
+    a = np.ascontiguousarray(a)
+    name = a.dtype.str
+    if a.dtype.kind == "V":
+        # ml_dtypes extension types (bfloat16 pools): numpy's .str is
+        # an opaque void tag ("|V2") the importer could not rebuild —
+        # ship the real name instead
+        name = a.dtype.name
+    return {"dtype": name, "shape": list(a.shape),
+            "data": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _unpack_array(d: dict) -> np.ndarray:
+    import base64
+    raw = base64.b64decode(d["data"])
+    try:
+        dtype = np.dtype(d["dtype"])
+    except TypeError:
+        import ml_dtypes
+        dtype = np.dtype(getattr(ml_dtypes, d["dtype"]))
+    return np.frombuffer(raw, dtype=dtype).reshape(
+        [int(s) for s in d["shape"]])
+
+
 class _Slot:
     """Host-side bookkeeping for one slot of the pool. Decode state
     (position, current token) lives on device; the host only accumulates
@@ -551,12 +595,14 @@ class Engine:
         self._kill_fn = jax.jit(lambda active, keep: active & keep)
         self._prefill_fns: Dict = {}
         self._warm_fn = None            # built lazily (prefix_cache)
-        if self.prefix is not None:
+        self._install_fn = None         # built lazily (migration import)
+        if self.kv == "paged":
             from dalle_pytorch_tpu.serve import kv_pool as KV
-            # the copy-on-write pair: snapshot one physical page at
-            # prefix insert, fork it into a warm consumer's private
-            # page. Pool updates go through the _jit_pool_update hook
-            # so a mesh engine can pin the KV shardings — an unpinned
+            # the page copy pair, shared by the prefix cache's
+            # copy-on-write fork AND live migration's export/import:
+            # snapshot one physical page, restore one physical page.
+            # Pool updates go through the _jit_pool_update hook so a
+            # mesh engine can pin the KV shardings — an unpinned
             # restore that drifted the pool's placement would silently
             # retrace the fused decode program (decode_traces catches
             # it, but pin instead of hope).
@@ -1821,6 +1867,259 @@ class Engine:
             decode_s=round(now - slot.t_admit, 6),
             total_s=round(now - req.submit_t, 6)))
         self._free_slot(i)
+
+    # -- live migration (kv='paged') ----------------------------------------
+    #
+    # A slot's entire decode state is movable: KV pages (fp32 or
+    # int8+scales), block-table order, device sampling state (pos,
+    # cur_tok, the base RNG key, temp/topk/top_p), the CFG shadow, and
+    # the host's emitted-token prefix. export_slot snapshots all of it
+    # into one JSON-safe payload (MIGRATE frames CRC+seq-check it like
+    # every other frame) and vacates the slot WITHOUT fulfilling or
+    # requeueing the handle — the request now lives in the payload, and
+    # import_slot installs it on a target engine with freshly allocated
+    # pages. Byte-identity holds because sampling is deterministic in
+    # (rng row, position) — fold_in(key, pos) — and every input to the
+    # fused program's next step ships: the continuation is the exact
+    # token stream the undisturbed run would have produced. Any failure
+    # is the typed MigrationError; the caller falls back to replay.
+
+    def _migrate_install_fn(self):
+        """The import-side state merge: same ``.at[slots].set`` scatter
+        as warm admission (unused rows aimed at the dropped out-of-range
+        index), but with pos/cur_tok/rng taken verbatim from the
+        exported device rows instead of re-derived. Compiled once."""
+        if self._install_fn is not None:
+            return self._install_fn
+
+        def install(cur_tok, pos, active, rng, temp, topk_k, top_p,
+                    slots, n_tok, n_pos, n_rng, n_temp, n_topk, n_top_p):
+            cur_tok = cur_tok.at[slots].set(n_tok, mode="drop")
+            pos = pos.at[slots].set(n_pos, mode="drop")
+            active = active.at[slots].set(True, mode="drop")
+            rng = rng.at[slots].set(n_rng, mode="drop")
+            temp = temp.at[slots].set(n_temp, mode="drop")
+            topk_k = topk_k.at[slots].set(n_topk, mode="drop")
+            top_p = top_p.at[slots].set(n_top_p, mode="drop")
+            return cur_tok, pos, active, rng, temp, topk_k, top_p
+
+        self._install_fn = self._jit_warm_program(install)
+        return self._install_fn
+
+    def find_slot(self, request_id: int) -> Optional[int]:
+        """The cond slot index holding ``request_id`` (None when not
+        in-slot — queued, mid-admission, or already gone)."""
+        for i, s in enumerate(self.slots):
+            if s is not None and s.shadow_of is None \
+                    and s.handle.request.request_id == int(request_id):
+                return i
+        return None
+
+    def _export_pages(self, pages: List[int]) -> List[dict]:
+        import jax
+        out = []
+        for pid in pages:
+            snap = self._snap_fn(self.cache, self._put(np.int32(pid)))
+            host = jax.device_get(snap)
+            out.append({k: _pack_array(v) for k, v in host.items()})
+        return out
+
+    def export_slot(self, i: int):
+        """Snapshot slot ``i``'s full decode state into a JSON-safe
+        migration payload and VACATE the slot (pages released, device
+        active bit cleared, handle neither fulfilled nor requeued — the
+        caller owns it now). A guided pair exports atomically: the
+        uncond shadow's pages and device rows ride in the same payload.
+        Returns ``(payload, handle)``; raises the typed
+        ``MigrationError`` on any precondition failure, leaving the
+        slot untouched."""
+        import jax
+        with self._lock:
+            if self.fenced:
+                raise MigrationError("fenced")
+            if self.kv != "paged":
+                raise MigrationError(
+                    "kv_dense", "migration moves KV pages; the dense "
+                    "slot cache has none")
+            # flush the in-flight pipeline first: the device pos and the
+            # host's emitted list must describe the SAME point in the
+            # stream, and no orphaned ring row may outlive the export
+            while self._pending:
+                self._harvest_chunk()
+            slot = self.slots[i] if 0 <= i < self.num_slots else None
+            if slot is None or slot.shadow_of is not None:
+                raise MigrationError("not_found", f"slot {i}")
+            if slot.handle.done():
+                # completed inside the flushed chunks — nothing to move
+                raise MigrationError("not_found",
+                                     "request completed during export")
+            now = self.clock()
+            (pos_h, tok_h, rng_h, temp_h, topk_h, topp_h) = \
+                jax.device_get((self.pos, self.cur_tok, self.rng,
+                                self.temp, self.topk_k, self.top_p))
+
+            def rows(j):
+                return {"pos": int(pos_h[j]), "cur_tok": int(tok_h[j]),
+                        "rng": [int(x) for x in rng_h[j]],
+                        "temp": float(temp_h[j]),
+                        "topk_k": int(topk_h[j]),
+                        "top_p": float(topp_h[j]),
+                        "pages": self._export_pages(self._slot_pages[j])}
+
+            payload = {
+                "format": 1,
+                "request_id": int(slot.handle.request.request_id),
+                "handle": slot.handle.to_wire(now),
+                "emitted": [int(t) for t in slot.emitted],
+                "t0": int(slot.t0),
+                "weights_version": self.weights_version,
+                "page_size": int(self.page_size),
+                "quantized": bool(self.quantize_cache),
+                "cond": rows(i),
+                "uncond": None,
+            }
+            j = slot.pair
+            if j is not None and self.slots[j] is not None \
+                    and self.slots[j].shadow_of == i:
+                payload["uncond"] = rows(j)
+                payload["uncond"]["cfg_scale"] = float(
+                    slot.handle.request.cfg_scale)
+            handle = slot.handle
+            self._span(handle, "migrate_out", now,
+                       slot=i, pos=int(pos_h[i]),
+                       tokens_done=len(slot.emitted))
+            killed = self._free_slot(i)
+            keep = np.ones((self.num_slots,), bool)
+            keep[killed] = False
+            self.active = self._kill_fn(self.active, self._put(keep))
+            return payload, handle
+
+    def export_request(self, request_id: int):
+        """``export_slot`` addressed by request id (the MIGRATE_OUT
+        frame's form — a parent names requests, not slot indices)."""
+        i = self.find_slot(request_id)
+        if i is None:
+            raise MigrationError("not_found", f"request {request_id} "
+                                 "is not in a slot on this engine")
+        return self.export_slot(i)
+
+    def import_slot(self, payload: dict,
+                    handle: Optional[S.RequestHandle] = None) -> int:
+        """Install an exported slot on THIS engine: allocate fresh
+        pages, restore the snapshot into them, scatter the exported
+        device rows into free slot(s), and resume harvesting where the
+        source left off. ``handle`` is the live handle in-process
+        (thread replicas); None reconstructs a stand-in from the
+        payload's wire form (a child worker). Returns the cond slot
+        index; raises the typed ``MigrationError`` (target unchanged)
+        when the request cannot land here."""
+        with self._lock:
+            if self.fenced:
+                raise MigrationError("fenced")
+            if self.kv != "paged":
+                raise MigrationError("kv_dense")
+            if str(payload.get("weights_version")) != self.weights_version:
+                raise MigrationError(
+                    "weights_version",
+                    f"snapshot from {payload.get('weights_version')!r}, "
+                    f"target serves {self.weights_version!r} — tokens "
+                    "are byte-identical PER weight generation only")
+            if int(payload.get("page_size", 0)) != self.page_size:
+                raise MigrationError(
+                    "page_size", f"snapshot pages hold "
+                    f"{payload.get('page_size')} rows, target pool "
+                    f"holds {self.page_size}")
+            if bool(payload.get("quantized")) != self.quantize_cache:
+                raise MigrationError(
+                    "layout", "int8-KV snapshot into an fp32 pool (or "
+                    "vice versa)")
+            now = self.clock()
+            if handle is None:
+                handle = S.RequestHandle.from_wire(payload["handle"], now)
+            parts = [payload["cond"]]
+            if payload.get("uncond") is not None:
+                parts.append(payload["uncond"])
+            free = [k for k, s in enumerate(self.slots) if s is None]
+            if len(free) < len(parts):
+                raise MigrationError(
+                    "target_slots", f"need {len(parts)} free slots, "
+                    f"have {len(free)}")
+            need = sum(len(p["pages"]) for p in parts)
+            if self.alloc.free < need and self.prefix is not None:
+                self.prefix.shrink(need)
+            try:
+                grants = self.alloc.alloc(need)
+            except Exception as e:
+                raise MigrationError(
+                    "target_pages", f"need {need} pages: {e}") from e
+            idx = free[:len(parts)]
+            G = self.num_slots
+            slots_arr = np.full((G,), G, np.int32)
+            n_tok = np.zeros((G,), np.int32)
+            n_pos = np.zeros((G,), np.int32)
+            n_rng = np.zeros((G, 2), np.uint32)
+            n_temp = np.ones((G,), np.float32)
+            n_topk = np.ones((G,), np.int32)
+            n_top_p = np.zeros((G,), np.float32)
+            try:
+                taken = 0
+                for j, part in enumerate(parts):
+                    k = idx[j]
+                    pages = grants[taken:taken + len(part["pages"])]
+                    taken += len(part["pages"])
+                    for pid, packed in zip(pages, part["pages"]):
+                        snap = {key: self._put(_unpack_array(packed[key]))
+                                for key in packed}
+                        self.cache = self._restore_fn(
+                            self.cache, self._put(np.int32(pid)), snap)
+                    self._bt_host[k, :] = 0
+                    self._bt_host[k, :len(pages)] = pages
+                    self._slot_pages[k] = list(pages)
+                    self._pos_est[k] = int(part["pos"])
+                    slots_arr[j] = k
+                    n_tok[j] = np.int32(part["cur_tok"])
+                    n_pos[j] = np.int32(part["pos"])
+                    n_rng[j] = np.asarray(part["rng"], np.uint32)
+                    n_temp[j] = np.float32(part["temp"])
+                    n_topk[j] = np.int32(part["topk_k"])
+                    n_top_p[j] = np.float32(part["top_p"])
+                self._bt_dirty = True
+                put = self._put
+                (self.cur_tok, self.pos, self.active, self.rng,
+                 self.temp, self.topk_k, self.top_p) = \
+                    self._migrate_install_fn()(
+                        self.cur_tok, self.pos, self.active, self.rng,
+                        self.temp, self.topk_k, self.top_p,
+                        put(slots_arr), put(n_tok), put(n_pos),
+                        put(n_rng), put(n_temp), put(n_topk),
+                        put(n_top_p))
+            except Exception as e:  # noqa: BLE001 — discard, never wedge
+                # a torn/corrupt snapshot mid-install: discard the
+                # partial import whole (no slot was assigned, the
+                # device active bits were never raised) so the source's
+                # replay fallback owns the request — page contents
+                # written before the failure are unreachable garbage
+                # behind the zeroed block-table rows
+                self.alloc.release(grants)
+                for k in idx:
+                    self._bt_host[k, :] = 0
+                    self._slot_pages[k] = []
+                    self._pos_est[k] = 0
+                self._bt_dirty = True
+                raise MigrationError("transfer", repr(e)) from e
+            i = idx[0]
+            t0 = int(payload["t0"])
+            self.slots[i] = _Slot(handle, t0, now)
+            self.slots[i].emitted = [int(t) for t in payload["emitted"]]
+            if len(parts) == 2:
+                j = idx[1]
+                self.slots[j] = _Slot(handle, t0, now, shadow_of=i)
+                self.slots[i].pair = j
+                self._cfg_wire(i, j, payload["uncond"]["cfg_scale"])
+            self._span(handle, "migrate_in", now, slot=i,
+                       pos=int(payload["cond"]["pos"]),
+                       tokens_done=len(payload["emitted"]))
+            return i
 
     # -- the loop -----------------------------------------------------------
 

@@ -10,9 +10,13 @@ set. Parent and child share nothing but a transport
 host-per-engine isolation and remote attach) carrying framed,
 versioned, sequence-numbered, checksummed messages:
 
-  parent -> child:  ADMIT (request batches), FENCE, SHUTDOWN, STATS_REQ
+  parent -> child:  ADMIT (request batches), FENCE, SHUTDOWN, STATS_REQ,
+                    MIGRATE_OUT (export one request's slot snapshot),
+                    MIGRATE_IN (install a snapshot exported elsewhere)
   child -> parent:  READY, HEARTBEAT, HARVEST (completed-result batches
-                    + the engine-state snapshot), STATS, CRASH, BYE
+                    + the engine-state snapshot), STATS, CRASH, BYE,
+                    MIGRATE_OUT (the export reply: snapshot or typed
+                    refusal), MIGRATE_ACK (the import verdict)
 
 Design rules, each load-bearing for the zero-loss contract:
 
@@ -96,10 +100,19 @@ BYE = "bye"
 # handshake (socket transport only; see transport.WorkerListener)
 HELLO = "hello"
 HELLO_OK = "hello_ok"
+# live migration (serve/engine.py export_slot/import_slot): MIGRATE_OUT
+# is bidirectional — the parent's export request and the child's reply
+# (snapshot or typed refusal); MIGRATE_IN ships a snapshot to a target
+# child, answered by MIGRATE_ACK. Appended AFTER the v2 kinds so every
+# existing frame keeps its positional id on the wire.
+MIGRATE_OUT = "migrate_out"
+MIGRATE_IN = "migrate_in"
+MIGRATE_ACK = "migrate_ack"
 
 KINDS = (ADMIT, FENCE, SHUTDOWN, STATS_REQ,
          READY, HEARTBEAT, HARVEST, STATS, CRASH, BYE,
-         HELLO, HELLO_OK)
+         HELLO, HELLO_OK,
+         MIGRATE_OUT, MIGRATE_IN, MIGRATE_ACK)
 _KIND_ID = {k: i for i, k in enumerate(KINDS)}
 
 _MAGIC = 0xD5
@@ -418,6 +431,10 @@ class ChildEngineClient:
         self.last_heartbeat = self.clock()
         self.last_frame_t = self.clock()    # ANY decoded frame stamps it
         self.stats_reply: Optional[dict] = None
+        # the child's parked answer to the ONE in-flight migration
+        # (export reply or import ack) — single-owner control thread,
+        # migrations run serially, so one slot suffices
+        self.migrate_reply: Optional[dict] = None
         # child-stamp -> parent-absorb lag per frame (the isolation tax
         # bench_serve's --isolation leg reports); perf_counter is
         # CLOCK_MONOTONIC on Linux — one epoch across processes
@@ -505,6 +522,80 @@ class ChildEngineClient:
     def request_stats(self) -> None:
         self._send(STATS_REQ, {})
 
+    # -- live migration (parent side) ----------------------------------------
+
+    def _await_migrate(self, timeout: float) -> Optional[dict]:
+        """Pump until the child answers the in-flight migration frame
+        (or the stream dies / the deadline passes — None). Absorbs
+        every other frame kind normally while waiting, so heartbeats
+        and harvests keep landing mid-transfer."""
+        deadline = self.clock() + timeout
+        while True:
+            self.pump(0.01)
+            reply, self.migrate_reply = self.migrate_reply, None
+            if reply is not None:
+                return reply
+            if self.poisoned or self.crashed or self.fenced \
+                    or not self.alive_proc() \
+                    or self.clock() >= deadline:
+                return None
+
+    def export_request(self, request_id: int,
+                       timeout: float = 30.0) -> dict:
+        """Ask the child to export ``request_id``'s slot (MIGRATE_OUT)
+        and return the snapshot payload. On success the child has
+        already vacated the slot — the parent-side handle stays in THIS
+        client's shadow until the caller hands it to the target. Raises
+        the typed ``MigrationError`` when the child refuses, dies
+        mid-transfer, or never answers (the replay-fallback signal:
+        the handle is still shadow-owned, so nothing is lost)."""
+        from dalle_pytorch_tpu.serve.engine import MigrationError
+        if int(request_id) not in self.shadow:
+            raise MigrationError(
+                "not_found", f"request {request_id} is not routed here")
+        if not self._send(MIGRATE_OUT, {"request_id": int(request_id)}):
+            raise MigrationError(
+                "source_dead",
+                self.last_error or "transport write failed")
+        reply = self._await_migrate(timeout)
+        if reply is None:
+            raise MigrationError(
+                "source_dead",
+                self.last_error or "source died or went silent "
+                "mid-transfer")
+        if not reply.get("ok"):
+            raise MigrationError(str(reply.get("reason") or "transfer"),
+                                 str(reply.get("error") or ""))
+        snap = reply.get("snap")
+        if not isinstance(snap, dict):
+            raise MigrationError("transfer", "malformed export reply "
+                                 "(no snapshot object)")
+        return snap
+
+    def import_request(self, snap: dict, handle: S.RequestHandle,
+                       timeout: float = 30.0) -> None:
+        """Ship an exported snapshot to this child (MIGRATE_IN) and
+        wait for its MIGRATE_ACK. The handle enters the shadow FIRST —
+        ``route``'s rule: if the child dies mid-import, the reclaim
+        sweep still owns the request and it replays. A refused or
+        unanswered import pops the handle back out and raises the
+        typed ``MigrationError`` so the caller's fallback ladder
+        (requeue-for-replay) runs."""
+        from dalle_pytorch_tpu.serve.engine import MigrationError
+        rid = int(snap.get("request_id", -1))
+        self.shadow[rid] = handle
+        sent = self._send(MIGRATE_IN, {"snap": snap})
+        reply = self._await_migrate(timeout) if sent else None
+        if reply is None or not reply.get("ok"):
+            self.shadow.pop(rid, None)
+            if reply is None:
+                raise MigrationError(
+                    "target_dead",
+                    self.last_error or "target died or went silent "
+                    "mid-import")
+            raise MigrationError(str(reply.get("reason") or "transfer"),
+                                 str(reply.get("error") or ""))
+
     # -- receiving ----------------------------------------------------------
 
     def pump(self, poll_s: float = 0.0) -> bool:
@@ -591,6 +682,10 @@ class ChildEngineClient:
             self.last_error = str(payload.get("error", "child crash"))
         elif kind == BYE:
             self.bye = True
+        elif kind in (MIGRATE_OUT, MIGRATE_ACK):
+            # the child's verdict on the in-flight export/import —
+            # parked for the control thread's _await_migrate
+            self.migrate_reply = payload
         else:
             raise IPCError(f"unexpected frame kind {kind!r} from child")
 

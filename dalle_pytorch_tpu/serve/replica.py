@@ -146,6 +146,7 @@ from typing import Callable, List, Optional
 
 from dalle_pytorch_tpu.serve import scheduler as S
 from dalle_pytorch_tpu.serve.engine import COUNTERS as _COUNTERS
+from dalle_pytorch_tpu.serve.engine import MigrationError
 
 # replica lifecycle states (``replica_states()`` / ``stats()``)
 RUNNING = "running"
@@ -157,6 +158,15 @@ RETIRED = "retired"      # scale-in tombstone: the slot never comes back
 
 ISOLATION_MODES = ("thread", "process")
 TRANSPORT_MODES = ("pipe", "socket")
+# replica roles (disaggregated serving): a ``prefill`` replica admits
+# and prefills, then live-migrates the warm request to a decode-capable
+# replica; a ``decode`` replica is skipped for fresh admissions while
+# any prefill-capable replica has capacity. ``both`` (the default) is
+# the classic undifferentiated shape. Roles are a PREFERENCE, never a
+# capability: every engine can prefill and decode, and zero-loss
+# routing outranks the role split whenever honoring it would strand a
+# request.
+REPLICA_ROLES = ("prefill", "decode", "both")
 
 
 class ScaleError(RuntimeError):
@@ -217,9 +227,10 @@ class _Replica:
                  "device", "attempt", "bringups", "next_bringup_t",
                  "last_error", "dead", "await_ready", "last_exit",
                  "conns", "version", "canary", "params_override",
-                 "ckpt_override", "born_scaled")
+                 "ckpt_override", "born_scaled", "role")
 
-    def __init__(self, index: int, device=None, version: str = "0"):
+    def __init__(self, index: int, device=None, version: str = "0",
+                 role: str = "both"):
         self.index = index
         self.state = BROKEN          # until the first bring-up succeeds
         self.engine = None
@@ -241,6 +252,7 @@ class _Replica:
         self.params_override = None  # upgrade: bring up on THESE params
         self.ckpt_override = None    # upgrade: ... or this ckpt path
         self.born_scaled = False     # created by add_replica (faults)
+        self.role = str(role)        # prefill | decode | both
 
 
 class ReplicaSet:
@@ -282,7 +294,8 @@ class ReplicaSet:
                  worker_quantize: str = "none",
                  devices_per_replica: int = 1,
                  weights_version: str = "0",
-                 max_replicas: int = 0):
+                 max_replicas: int = 0,
+                 roles=None):
         import jax
 
         from dalle_pytorch_tpu.resilience import faults
@@ -290,6 +303,23 @@ class ReplicaSet:
 
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.roles = tuple(str(x) for x in roles) if roles else ()
+        for role in self.roles:
+            if role not in REPLICA_ROLES:
+                raise ValueError(f"replica role must be one of "
+                                 f"{REPLICA_ROLES}, got {role!r}")
+        if self.roles and len(self.roles) != replicas:
+            raise ValueError(
+                f"roles names {len(self.roles)} replicas but the set "
+                f"starts with {replicas}")
+        if self.roles and kv != "paged" \
+                and any(x != "both" for x in self.roles):
+            # disaggregated roles work by LIVE-MIGRATING warm requests
+            # from prefill to decode replicas, and migration ships KV
+            # pages — a dense cache has no transferable pages
+            raise ValueError(
+                "prefill/decode replica roles need kv='paged' (the "
+                "prefill->decode handoff live-migrates KV pages)")
         self.weights_version = str(weights_version)
         self.max_replicas = int(max_replicas)
         if self.max_replicas and self.max_replicas < replicas:
@@ -430,7 +460,8 @@ class ReplicaSet:
         for i in range(self.n_replicas):
             self.replicas.append(_Replica(
                 i, device=self._device_for(i),
-                version=self.weights_version))
+                version=self.weights_version,
+                role=self.roles[i] if self.roles else "both"))
 
         # supervisor counters + retired-engine counter base: a fenced
         # engine's numbers are folded in here at reclaim time (minus the
@@ -447,6 +478,15 @@ class ReplicaSet:
         self.scale_ins = 0
         self.upgrades = 0            # completed rolling upgrades
         self._upgrading = False      # one reshape owner at a time
+        # live KV migration (drain/scale-in/upgrade/role handoff):
+        # set-level counters — migration is a SET concern (a request
+        # moving between engines), so the counters live here rather
+        # than in every engine's COUNTERS tuple
+        self.migrations = 0
+        self.migrate_fallbacks = 0
+        self.migrated_tokens_saved = 0
+        self.migration_seconds: List[float] = []  # histogram samples
+        self._role_sweep_t = 0.0     # prefill->decode handoff pacing
         # set-level HOL page reservations handed back by fenced/drained
         # replicas: {request_id: pages_needed}. The router routes such a
         # request with its EXACT (prefix-aware) need instead of the
@@ -858,19 +898,216 @@ class ReplicaSet:
         r.next_bringup_t = now          # first restart attempt is free;
         #                                 backoff only after it fails
 
+    # -- live KV migration (drain / scale-in / upgrade / roles) -------------
+
+    def _migrate_targets(self, src: _Replica,
+                         pin: Optional[str],
+                         exclude_prefill: bool = False) -> List[_Replica]:
+        """Replicas that could take a migrated request RIGHT NOW:
+        serving, not a canary, version-matched when the request is
+        pinned, with slot capacity. Decode-capable targets sort first
+        (a ``prefill`` replica is a landing spot of last resort — and
+        never one at all for the prefill->decode handoff sweep, which
+        would otherwise ping-pong work between prefill replicas)."""
+        out = []
+        for x in self.replicas:
+            if x is src or x.state != RUNNING or x.engine is None \
+                    or x.canary:
+                continue
+            if pin is not None and x.version != pin:
+                continue
+            if exclude_prefill and x.role == "prefill":
+                continue
+            if not self._replica_serving(x):
+                continue
+            if self._capacity(x) <= 0:
+                continue
+            out.append(x)
+        out.sort(key=lambda x: (x.role == "prefill",
+                                -self._capacity(x), x.index))
+        return out
+
+    def _inslot_requests(self, r: _Replica):
+        """``(request_id, handle)`` for every request that may hold a
+        live slot on ``r`` — exact for a thread engine (read off its
+        slot table), the full shadow for a process child (the parent
+        cannot see which shadow entries are in-slot; an export of a
+        merely-queued one answers ``not_found`` and is skipped).
+        Canary probes never migrate — they exist to gate ONE replica."""
+        if self.isolation == "process":
+            return [(rid, h) for rid, h in list(r.engine.shadow.items())
+                    if not h.done() and not getattr(h, "canary", False)]
+        eng = r.engine
+        out = []
+        with eng._lock:
+            for s in eng.slots:
+                if s is not None and s.shadow_of is None \
+                        and not s.handle.done() \
+                        and not getattr(s.handle, "canary", False):
+                    out.append((s.handle.request.request_id, s.handle))
+        return out
+
+    def _migrate_fallback(self, src: _Replica, rid: int,
+                          handle: Optional[S.RequestHandle],
+                          reason: str, detail: str, now: float) -> None:
+        """One migration attempt giving up: structured event + counter,
+        and — when the export already VACATED the source slot (handle
+        in hand) — the replay fallback itself: requeue at the original
+        arrival position, exactly like a fence reclaim. With no handle
+        the request never left the source, so the fence that follows a
+        failed drain-migration replays it through the normal path."""
+        self.migrate_fallbacks += 1
+        self._event("serve_migrate_fallback", request_id=rid,
+                    replica=src.index, reason=reason, error=detail)
+        if handle is not None and not handle.done():
+            self._mark_replay(handle, f"migration fallback ({reason})",
+                              src.index)
+            self.queue.requeue(handle)
+
+    def _migrate_from(self, src: _Replica, now: float, reason: str,
+                      pin_version: Optional[str] = None,
+                      exclude_prefill: bool = False) -> int:
+        """Move ``src``'s in-slot requests to live targets MID-STREAM
+        — KV pages, decode cursor, RNG and all — instead of replaying
+        them from token zero. The planned-downtime paths (operator
+        drain, scale-in, rolling-upgrade drain, autoscaler scale-in)
+        call this immediately before their fence; the prefill->decode
+        role sweep calls it on a healthy source. Replay stays the
+        automatic fallback at every rung: source dead or denies the
+        export -> the fence's reclaim replays; export succeeded but no
+        target can map it -> requeued for replay right here. Returns
+        the number of requests migrated."""
+        from dalle_pytorch_tpu.resilience import faults
+        if self.kv != "paged" or src.engine is None:
+            return 0    # dense KV has no transferable pages
+        if not self._replica_serving(src):
+            return 0    # a corpse answers nothing: replay handles it
+        moved = 0
+        for rid, pre in self._inslot_requests(src):
+            pin = getattr(pre, "replay_version", None) or pin_version \
+                or src.version
+            targets = self._migrate_targets(src, pin, exclude_prefill)
+            if not targets:
+                break   # nowhere to land anything: fence will replay
+            t0 = time.perf_counter()
+            handle: Optional[S.RequestHandle] = None
+            try:
+                # the crash-mid-transfer fault row: SIGKILL the source
+                # exactly as the snapshot is requested — the export
+                # times out against a corpse and everything it held
+                # falls back to fence-reclaim replay, zero loss
+                faults.on_migrate_transfer(
+                    src.index,
+                    getattr(src.engine, "pid", None)
+                    if self.isolation == "process" else None)
+                if self.isolation == "process":
+                    snap = src.engine.export_request(rid)
+                    handle = src.engine.shadow.pop(rid, None)
+                    if handle is None:
+                        raise MigrationError(
+                            "not_found", "no shadow handle for the "
+                            "exported request")
+                else:
+                    snap, handle = src.engine.export_request(rid)
+            except MigrationError as e:
+                if e.reason == "not_found":
+                    # queued / mid-admission / just completed: nothing
+                    # mid-stream to move — not a fallback, the normal
+                    # paths own it
+                    continue
+                self._migrate_fallback(src, rid, handle, e.reason,
+                                       str(e), now)
+                if not self._replica_serving(src):
+                    break   # source died under us: fence replays rest
+                continue
+            except faults.FaultInjected as e:
+                self._migrate_fallback(src, rid, handle, "source_dead",
+                                       str(e), now)
+                continue
+            saved = len(snap.get("emitted") or ())
+            dst = None
+            err_reason, err_detail = "target_pages", ""
+            for tgt in targets:
+                try:
+                    # the reject-target fault row: the target reports
+                    # page exhaustion at import time
+                    faults.on_migrate_import(tgt.index)
+                    if self.isolation == "process":
+                        tgt.engine.import_request(snap, handle)
+                    else:
+                        tgt.engine.import_slot(snap, handle)
+                    dst = tgt
+                    break
+                except MigrationError as e:
+                    err_reason, err_detail = e.reason, str(e)
+                except faults.FaultInjected as e:
+                    err_reason, err_detail = "target_pages", str(e)
+            if dst is None:
+                # the export vacated the source slot and credited its
+                # prefix to the source's counters; the replay re-decodes
+                # and re-credits every token, so un-credit here to keep
+                # the aggregate counting DISTINCT delivered tokens (the
+                # same discipline as eviction and fence reclaim)
+                self._retired["tokens_decoded"] -= saved
+                self._retired["occupancy_sum"] -= saved
+                self._migrate_fallback(src, rid, handle, err_reason,
+                                       err_detail, now)
+                continue
+            wall = time.perf_counter() - t0
+            moved += 1
+            self.migrations += 1
+            self.migrated_tokens_saved += saved
+            self.migration_seconds.append(wall)
+            if handle.trace is not None:
+                self.flight.record(handle.trace.span(
+                    "migrate", now, src=src.index, dst=dst.index,
+                    tokens_saved=saved))
+            self._event("serve_migrated", request_id=rid,
+                        src=src.index, dst=dst.index,
+                        tokens_saved=saved, reason=reason,
+                        wall_s=round(wall, 4))
+        return moved
+
+    def _role_handoff(self, now: float) -> bool:
+        """The disaggregated-serving sweep: a ``prefill`` replica keeps
+        admission + prefill and hands every warm (in-slot, decoding)
+        request to a decode-capable replica the moment one has
+        capacity. Paced — the sweep costs an export probe per in-slot
+        request, so it runs at most every 50ms, and not at all in a
+        homogeneous (all-``both``) fleet."""
+        if self.kv != "paged" or self._upgrading:
+            return False
+        sources = [r for r in self.replicas
+                   if r.state == RUNNING and r.role == "prefill"
+                   and r.engine is not None]
+        if not sources or now - self._role_sweep_t < 0.05:
+            return False
+        self._role_sweep_t = now
+        did = False
+        for r in sources:
+            did = bool(self._migrate_from(
+                r, now, reason="prefill_handoff",
+                exclude_prefill=True)) or did
+        return did
+
     # -- operator drain -----------------------------------------------------
 
     def drain_replica(self, index: int,
                       reason: str = "operator drain") -> int:
-        """Planned maintenance: fence + reclaim (in-flight work replays
-        on the survivors, zero requests lost) and hold the replica DOWN
-        until ``undrain_replica``. Returns the number reclaimed."""
+        """Planned maintenance: live-migrate the in-flight work to
+        survivors mid-stream (each moved request keeps every token it
+        already decoded), then fence + reclaim whatever could not move
+        (replays on the survivors — zero requests lost either way) and
+        hold the replica DOWN until ``undrain_replica``. Returns the
+        number of requests handed to survivors (migrated + reclaimed)."""
         with self._ctl_lock:
             self._reject_mid_upgrade("drain")
             r = self._replica_or_reject("drain", index)
+            now = self.clock()
+            moved = self._migrate_from(r, now, reason=reason)
             n = self._fence_and_reclaim(r, self.clock(), reason)
             r.state = DRAINED
-            return n
+            return moved + n
 
     def undrain_replica(self, index: int) -> bool:
         """Bring a drained replica back into routing (one bring-up
@@ -902,7 +1139,7 @@ class ReplicaSet:
         if self._upgrading:
             raise self._scale_error(op, reason="upgrade_in_progress")
 
-    def add_replica(self) -> int:
+    def add_replica(self, role: str = "both") -> int:
         """Runtime scale-out: append one new supervised slot — same
         isolation/transport/mesh shape as the rest of the set — and
         bring it up now. The replica joins routing ATOMICALLY once
@@ -916,6 +1153,12 @@ class ReplicaSet:
         integer. Returns the new replica's index."""
         with self._ctl_lock:
             self._reject_mid_upgrade("add")
+            if role not in REPLICA_ROLES:
+                raise self._scale_error("add", reason="unknown_role",
+                                        role=str(role))
+            if role != "both" and self.kv != "paged":
+                raise self._scale_error(
+                    "add", reason="roles_need_paged_kv", role=role)
             active = [r for r in self.replicas if r.state != RETIRED]
             if self.max_replicas and len(active) >= self.max_replicas:
                 raise self._scale_error(
@@ -924,7 +1167,7 @@ class ReplicaSet:
                     max_replicas=self.max_replicas)
             index = len(self.replicas)
             r = _Replica(index, device=self._device_for(index),
-                         version=self.weights_version)
+                         version=self.weights_version, role=role)
             r.born_scaled = True
             self.replicas.append(r)
             self.n_replicas = len(active) + 1
@@ -938,13 +1181,17 @@ class ReplicaSet:
     def remove_replica(self, index: int, drain: bool = True,
                        reason: str = "operator scale-in") -> int:
         """Runtime scale-in: drain ``index``'s in-flight work to the
-        survivors (the same fence→reclaim→replay as failover — the
-        reclaim is unconditional, zero-loss is not a flag; ``drain``
-        names the operator's intent in the event stream) and RETIRE
-        the slot for good. Removing the last live replica is a typed
-        ``ScaleError``: a set with no slots is not a smaller fleet, it
-        is an outage an operator almost certainly didn't mean. Returns
-        the number of requests reclaimed to survivors."""
+        survivors — LIVE-MIGRATED mid-stream first (KV pages + decode
+        cursor move; every already-decoded token is kept), with the
+        fence→reclaim→replay of failover as the unconditional fallback
+        for anything that could not move (zero-loss is not a flag;
+        ``drain=False`` skips the migration pass and names the
+        operator's replay-only intent in the event stream) — and
+        RETIRE the slot for good. Removing the last live replica is a
+        typed ``ScaleError``: a set with no slots is not a smaller
+        fleet, it is an outage an operator almost certainly didn't
+        mean. Returns the number of requests handed to survivors
+        (migrated + reclaimed)."""
         with self._ctl_lock:
             self._reject_mid_upgrade("remove")
             r = self._replica_or_reject("remove", index)
@@ -953,6 +1200,9 @@ class ReplicaSet:
             if not survivors:
                 raise self._scale_error("remove", replica=index,
                                         reason="remove_last_replica")
+            now = self.clock()
+            moved = self._migrate_from(r, now, reason=reason) \
+                if drain else 0
             n = self._fence_and_reclaim(r, self.clock(), reason)
             r.state = RETIRED
             r.params_override = None
@@ -960,8 +1210,9 @@ class ReplicaSet:
             self.n_replicas = len(survivors)
             self.scale_ins += 1
             self._event("serve_scale_in", replica=index, drain=drain,
-                        reclaimed=n, replicas=self.n_replicas)
-            return n
+                        migrated=moved, reclaimed=n,
+                        replicas=self.n_replicas)
+            return moved + n
 
     # -- elastic fleet: rolling weight hot-swap -----------------------------
 
@@ -1158,6 +1409,15 @@ class ReplicaSet:
                     getattr(r.engine, "pid", None)
                     if self.isolation == "process" else None)
                 with self._ctl_lock:
+                    # live-migrate first, version-pinned exactly like
+                    # replay: only survivors still serving THIS
+                    # replica's (old) generation may take its work
+                    # mid-stream — same-seed tokens are byte-identical
+                    # per weights_version, not across them
+                    migrated = self._migrate_from(
+                        r, self.clock(),
+                        reason=f"rolling upgrade to {version}",
+                        pin_version=r.version)
                     reclaimed = self._fence_and_reclaim(
                         r, self.clock(),
                         reason=f"rolling upgrade to {version}")
@@ -1226,11 +1486,13 @@ class ReplicaSet:
                                         replica_timeout_s)
                 r.canary = False
                 self._event("serve_upgrade_replica", replica=r.index,
-                            to=version, reclaimed=reclaimed,
+                            to=version, migrated=migrated,
+                            reclaimed=reclaimed,
                             canaries=len(handles),
                             wall_s=round(time.perf_counter() - t0, 3))
                 record["replicas"].append({
-                    "replica": r.index, "reclaimed": reclaimed,
+                    "replica": r.index, "migrated": migrated,
+                    "reclaimed": reclaimed,
                     "wall_s": round(time.perf_counter() - t0, 3)})
             with self._ctl_lock:
                 # promote: the new generation is now the set's truth —
@@ -1545,6 +1807,13 @@ class ReplicaSet:
             pin = h.replay_version
             cands = [r for r in live if caps[r.index] > 0
                      and (pin is None or r.version == pin)]
+            # role preference: every admission (fresh or replay) needs
+            # a prefill, so decode-specialized replicas are offered
+            # work only when no prefill-capable candidate has capacity
+            # — a PREFERENCE: zero-loss progress outranks the role
+            # split, so the fallback to any candidate is automatic
+            preferred = [r for r in cands if r.role != "decode"]
+            cands = preferred or cands
             if not cands:
                 # version-pinned replay with no same-generation
                 # capacity right now: hold or release, never mis-route
@@ -1653,6 +1922,7 @@ class ReplicaSet:
                     busy = self._pump_children(now)
                 busy = self._check_replicas(now) or busy
                 busy = self._route(now) or busy
+                busy = self._role_handoff(now) or busy
             stop.wait(0.0005 if busy else self._idle_sleep_s)
 
     # -- lifecycle ----------------------------------------------------------
@@ -1747,6 +2017,7 @@ class ReplicaSet:
                 did = self._pump_children(now)
             did = self._check_replicas(now) or did
             did = self._route(now) or did
+            did = self._role_handoff(now) or did
         if self.isolation == "process":
             # the children step themselves; the parent's "step" is the
             # pump/supervise/route above. Nap briefly when nothing
@@ -1855,7 +2126,7 @@ class ReplicaSet:
                     (r.thread is None or r.thread.is_alive())
             rec = {"replica": r.index, "state": r.state, "alive": alive,
                    "bringups": r.bringups,
-                   "weights_version": r.version}
+                   "weights_version": r.version, "role": r.role}
             if r.canary:
                 rec["canary"] = True    # upgrading: gate-only, unrouted
             if r.engine is not None:
@@ -1922,7 +2193,7 @@ class ReplicaSet:
         per = []
         for r in self.replicas:
             rec = {"replica": r.index, "state": r.state,
-                   "weights_version": r.version}
+                   "weights_version": r.version, "role": r.role}
             if r.engine is not None:
                 e = r.engine
                 rec.update({
@@ -2000,6 +2271,10 @@ class ReplicaSet:
             "scale_ins": self.scale_ins,
             "upgrades": self.upgrades,
             "upgrading": self._upgrading,
+            # live KV migration (drain/scale-in/upgrade/role handoff)
+            "migrations": self.migrations,
+            "migrate_fallbacks": self.migrate_fallbacks,
+            "migrated_tokens_saved": self.migrated_tokens_saved,
             "hol_handoffs": self.hol_handoffs,
             "flight_events": len(self.flight),
             "per_replica": per,

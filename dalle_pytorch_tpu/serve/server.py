@@ -58,6 +58,7 @@ class InferenceServer:
                  prefix_cache: bool = False,
                  default_cfg_scale: float = 0.0,
                  replicas: int = 1,
+                 replica_roles=None,
                  mesh_devices: int = 1,
                  weights_version: str = "0",
                  max_replicas: int = 0,
@@ -112,6 +113,13 @@ class InferenceServer:
         self.max_replicas = int(max_replicas)
         self._is_set = (self.replicas > 1 or autoscale is not None
                         or self.max_replicas > 1)
+        self.replica_roles = tuple(replica_roles) if replica_roles \
+            else None
+        if self.replica_roles and not self._is_set:
+            # a lone engine has nobody to migrate warm requests to —
+            # the disaggregated shape needs a set
+            raise ValueError("replica_roles requires a replica set "
+                             "(replicas >= 2)")
         if autoscale is not None:
             # the policy caps and the set cap must agree, or the
             # autoscaler would ask for replicas the set typed-rejects
@@ -180,7 +188,8 @@ class InferenceServer:
                 worker_quantize=worker_quantize,
                 devices_per_replica=self.mesh_devices,
                 weights_version=self.weights_version,
-                max_replicas=self.max_replicas)
+                max_replicas=self.max_replicas,
+                roles=self.replica_roles)
             if self.autoscale_policy is not None:
                 from dalle_pytorch_tpu.serve.autoscale import Autoscaler
                 # the set's RecordingMetrics: every autoscale_decision
@@ -260,6 +269,12 @@ class InferenceServer:
             "request",
             buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
                      50.0, 100.0, 250.0, 1000.0))
+        self.hist_migration = self.registry.histogram(
+            "dalle_serve_migration_seconds",
+            "Wall seconds per successful live slot migration "
+            "(export -> installed on the target)",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
         # serializes /admin/profile's sibling-capture check + arm (two
         # concurrent POSTs targeting different thread-mode replicas
         # must not both pass the per-process-singleton guard)
@@ -462,7 +477,7 @@ class InferenceServer:
                 reason="not_a_replica_set"))
         rs = self.engine
         if op == "add":
-            index = rs.add_replica()
+            index = rs.add_replica(role=str(kwargs.get("role", "both")))
             return {"op": op, "replica": index,
                     "replicas": rs.n_replicas}
         if op == "remove":
@@ -525,6 +540,12 @@ class InferenceServer:
 
     def stats(self) -> dict:
         out = self.engine.stats()
+        if self._is_set:
+            # drain the set's migration wall-time samples into the
+            # exposition histogram (the set records, the server exposes)
+            samples = self.engine.migration_seconds
+            while samples:
+                self.hist_migration.observe(samples.pop(0))
         e2e_ps = self.hist_e2e.percentiles((0.50, 0.95, 0.99))
         out.update({
             "requests_submitted": self.queue.submitted,
@@ -585,6 +606,13 @@ class InferenceServer:
          "Elastic scale-in actions"),
         ("upgrades", "dalle_serve_upgrades_total",
          "Completed rolling weight upgrades"),
+        ("migrations", "dalle_serve_migrations_total",
+         "Live slot migrations completed (drain/scale-in/upgrade/roles)"),
+        ("migrate_fallbacks", "dalle_serve_migrate_fallbacks_total",
+         "Migrations that fell back to deterministic replay"),
+        ("migrated_tokens_saved",
+         "dalle_serve_migrated_tokens_saved_total",
+         "Tokens live migration avoided re-decoding"),
         ("profiles_taken", "dalle_serve_profiles_taken_total",
          "Completed POST /admin/profile captures"),
     )

@@ -251,7 +251,7 @@ def _run(spec: dict, conn, sender: _FrameSender, rx_seq: int) -> None:
 
     import jax
 
-    from dalle_pytorch_tpu.serve.engine import Engine
+    from dalle_pytorch_tpu.serve.engine import Engine, MigrationError
 
     devices = jax.devices()
     params = spec["params"]
@@ -351,6 +351,51 @@ def _run(spec: dict, conn, sender: _FrameSender, rx_seq: int) -> None:
                 return
             elif kind == ipc.STATS_REQ:
                 sender.send(ipc.STATS, {"stats": engine.stats()})
+            elif kind == ipc.MIGRATE_OUT:
+                # export the named request's live slot and ship the
+                # snapshot back. Success VACATES the slot: the request
+                # leaves this worker un-fulfilled (the parent moves its
+                # shadow to the target), so it is dropped from
+                # open_handles WITHOUT a result frame — the target's
+                # completion ships it.
+                rid = int(payload["request_id"])
+                try:
+                    snap, _h = engine.export_request(rid)
+                except MigrationError as e:
+                    sender.send(ipc.MIGRATE_OUT, {
+                        "request_id": rid, "ok": False,
+                        "reason": e.reason, "error": str(e)})
+                except Exception as e:    # noqa: BLE001 — typed fallback
+                    sender.send(ipc.MIGRATE_OUT, {
+                        "request_id": rid, "ok": False,
+                        "reason": "transfer", "error": repr(e)})
+                else:
+                    open_handles.pop(rid, None)
+                    sender.send(ipc.MIGRATE_OUT, {
+                        "request_id": rid, "ok": True, "snap": snap})
+            elif kind == ipc.MIGRATE_IN:
+                # install an exported slot here; the stand-in handle
+                # import_slot builds from the payload's wire form joins
+                # open_handles so its completion ships as a normal
+                # harvest result. A failed import leaves this engine
+                # untouched (import_slot discards partial state) — the
+                # NACK tells the parent to fall back to replay.
+                snap = payload["snap"]
+                rid = int(snap.get("request_id", -1))
+                try:
+                    slot_i = engine.import_slot(snap)
+                except MigrationError as e:
+                    sender.send(ipc.MIGRATE_ACK, {
+                        "request_id": rid, "ok": False,
+                        "reason": e.reason, "error": str(e)})
+                except Exception as e:    # noqa: BLE001 — typed fallback
+                    sender.send(ipc.MIGRATE_ACK, {
+                        "request_id": rid, "ok": False,
+                        "reason": "transfer", "error": repr(e)})
+                else:
+                    open_handles[rid] = engine.slots[slot_i].handle
+                    sender.send(ipc.MIGRATE_ACK,
+                                {"request_id": rid, "ok": True})
             else:
                 raise ipc.IPCError(
                     f"unexpected frame kind {kind!r} from parent")
