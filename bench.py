@@ -2447,6 +2447,191 @@ def _serve_migrate_compare(params, cfg, *, num_slots, page_size,
     }
 
 
+def _serve_gateway_compare(params, cfg, *, num_slots, page_size):
+    """The gateway-tier record (docs/SERVING.md 'Gateway tier'), two
+    asserted halves:
+
+      * ROUTING — the same repeated-prompt workload (2 prompts x 5
+        waves, submission order rotated per wave) through two fresh
+        2-cell fleets: prefix-affinity routing vs hash-blind
+        least-loaded. Affinity sends a repeated prompt to the cell
+        whose PrefixIndex is already warm, so its fleet-wide prefix-hit
+        rate must be STRICTLY higher — hash-blind placement follows
+        arrival order, which the rotation deliberately scrambles, so
+        each prompt's entry lands on whichever cell the tie-break
+        picked that wave and the early waves all miss.
+      * DEGRADATION — the ``tenant_flood`` fault row drives a synthetic
+        abusive tenant (24 requests against an rps=2 bucket) against a
+        weight-2 victim on a shared fleet. The contract: the abuser
+        sees typed 429s (``tenant_throttled`` with retry-after), every
+        ADMITTED request — victim and abuser both — completes OK (zero
+        dropped), and the victim's p95 stays within 1.5x its unloaded
+        baseline (plus a small additive epsilon for CPU clock jitter,
+        recorded in the output).
+
+    Both halves raise AssertionError on violation — CI's serve-gateway
+    smoke greps the structured ``"error"`` field like every sibling
+    compare leg. The record carries one sample ``gateway_route`` and
+    one ``tenant_throttled`` event dict so the smoke can also pin the
+    typed-event field names."""
+    from dalle_pytorch_tpu.resilience import faults
+    from dalle_pytorch_tpu.serve import pages_for
+    from dalle_pytorch_tpu.serve.gateway import Gateway
+    from dalle_pytorch_tpu.serve.server import InferenceServer
+    from dalle_pytorch_tpu.serve.tenancy import TenantTable, \
+        TenantThrottled
+
+    slots = min(num_slots, 2)
+    prompt_len = min(4, cfg.text_seq_len)
+
+    def fleet(**gw_kwargs):
+        # vae_params=None is safe: decode_images=False means the
+        # postprocess stage (the only consumer) is never built
+        cells = [InferenceServer(params, None, cfg, num_slots=slots,
+                                 queue_depth=64, kv="paged",
+                                 page_size=page_size,
+                                 prefix_cache=True,
+                                 decode_images=False,
+                                 weights_version="v0").start()
+                 for _ in range(2)]
+        return Gateway(cells, cfg=cfg, model_version="v0",
+                       queue_depth=64,
+                       max_prompt_len=cfg.text_seq_len,
+                       pages_per_request=pages_for(cfg.seq_len,
+                                                   page_size),
+                       **gw_kwargs).start()
+
+    # -- leg (a): prefix-affinity vs hash-blind hit rate ---------------
+    prompts = [(1,) * prompt_len, (2,) * prompt_len]
+    waves = 5
+
+    def routing_leg(affinity, tag):
+        gw = fleet(affinity=affinity)
+        try:
+            for w in range(waves):
+                # waves of len(prompts) <= one cell's capacity, so the
+                # affine cell is never saturated; the rotation is what
+                # makes hash-blind placement drift between cells
+                order = prompts if w % 2 == 0 else prompts[::-1]
+                handles = [gw.submit(p, seed=0) for p in order]
+                for h in handles:
+                    r = h.result(timeout=180)
+                    if not r.ok:
+                        raise AssertionError(
+                            f"gateway routing leg {tag!r} wave {w}: "
+                            f"{r.status} ({r.reason})")
+            st = gw.stats()
+            return {
+                "hit_rate": st["fleet_prefix_hit_rate"],
+                "prefix_hits": st["fleet"]["prefix_hits"],
+                "completed": st["fleet"]["completed"],
+                "routed": st["routed"], "spills": st["spills"],
+            }, gw.events("gateway_route")
+        finally:
+            gw.close()
+
+    affine, route_events = routing_leg(True, "affinity")
+    blind, _ = routing_leg(False, "hash_blind")
+    if affine["hit_rate"] <= blind["hit_rate"]:
+        raise AssertionError(
+            f"prefix-affinity routing must beat hash-blind on the "
+            f"repeated-prompt workload: affinity hit rate "
+            f"{affine['hit_rate']} vs {blind['hit_rate']}")
+
+    # -- leg (b): tenant_flood degradation contract --------------------
+    def p95(lats):
+        s = sorted(lats)
+        return s[min(int(0.95 * (len(s) - 1) + 0.5), len(s) - 1)]
+
+    tenants = TenantTable.from_json([
+        {"name": "victim", "key": "kv", "weight": 2.0},
+        {"name": "abuser", "key": "ka", "weight": 1.0, "rps": 2.0}])
+    gw = fleet(tenants=tenants)
+    victim_prompt = (3,) * prompt_len
+    abuser_prompt = (4,) * prompt_len
+
+    def victim_round(n, tag):
+        lats = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            r = gw.generate(victim_prompt, api_key="kv", seed=0,
+                            timeout=180)
+            if not r.ok:
+                raise AssertionError(
+                    f"victim request dropped during {tag}: "
+                    f"{r.status} ({r.reason})")
+            lats.append(time.perf_counter() - t0)
+        return lats
+
+    try:
+        # compile + warm both prompts outside the timed rounds (the
+        # abuser warmup spends one rps token; the flood accounts below)
+        gw.generate(victim_prompt, api_key="kv", seed=0, timeout=300)
+        gw.generate(abuser_prompt, api_key="ka", seed=0, timeout=300)
+        baseline = victim_round(6, "baseline")
+        throttled = 0
+        sample_throttle = None
+        flood_handles = []
+        with faults.injected(tenant_flood="abuser",
+                             tenant_flood_requests=24):
+            flood = faults.gateway_flood()
+            for i in range(flood["requests"]):
+                try:
+                    flood_handles.append(gw.submit(
+                        abuser_prompt, api_key="ka", seed=i))
+                except TenantThrottled as e:
+                    throttled += 1
+                    sample_throttle = e.record
+            flooded = victim_round(6, "flood")
+        if throttled < 1:
+            raise AssertionError(
+                f"the abuser flood was never throttled "
+                f"({len(flood_handles)} admitted) — the rps bucket "
+                f"is not enforcing")
+        for h in flood_handles:
+            r = h.result(timeout=180)
+            if not r.ok:
+                raise AssertionError(
+                    f"an ADMITTED abuser request was dropped "
+                    f"({r.status}: {r.reason}) — throttling must "
+                    f"happen at admission, never after")
+        baseline_p95, flooded_p95 = p95(baseline), p95(flooded)
+        # the additive epsilon absorbs CPU-smoke clock jitter on a
+        # baseline measured in tens of milliseconds; on a real fleet
+        # the 1.5x ratio is the binding term
+        eps_s = 0.25
+        if flooded_p95 > 1.5 * baseline_p95 + eps_s:
+            raise AssertionError(
+                f"victim p95 degraded past tolerance under tenant "
+                f"flood: {flooded_p95:.3f}s vs 1.5 * "
+                f"{baseline_p95:.3f}s + {eps_s}s unloaded")
+        flood_rec = {
+            "baseline_p95_s": round(baseline_p95, 4),
+            "flooded_p95_s": round(flooded_p95, 4),
+            "ratio": round(flooded_p95 / max(baseline_p95, 1e-9), 2),
+            "epsilon_s": eps_s,
+            "victim_completed": len(baseline) + len(flooded),
+            "victim_dropped": 0,
+            "abuser_admitted": len(flood_handles),
+            "abuser_throttled": throttled,
+        }
+        tstats = gw.tenants.stats()
+    finally:
+        gw.close()
+
+    return {
+        "affinity": affine, "hash_blind": blind,
+        "affinity_advantage": round(
+            affine["hit_rate"] - blind["hit_rate"], 4),
+        "flood": flood_rec,
+        "tenants": tstats,
+        "sample_events": {
+            "gateway_route": route_events[0],
+            "tenant_throttled": sample_throttle,
+        },
+    }
+
+
 def _serve_mesh_compare(params, cfg, *, mesh_devices, num_slots, n_req,
                         kv, page_size, chunk_steps=8):
     """The mesh-sharded engine record (docs/SERVING.md 'Mesh-sharded
@@ -2841,6 +3026,18 @@ def bench_serve(args):
             migration_compare = {"error": f"{type(e).__name__}: {e}"}
             errors.append(str(e))
 
+    gateway_compare = None
+    if args.serve_gateway:
+        _progress("serve: gateway tier — affinity-vs-hash-blind "
+                  "routing + tenant-flood degradation contract")
+        try:
+            gateway_compare = _serve_gateway_compare(
+                params, cfg, num_slots=num_slots, page_size=page_size)
+        except Exception as e:  # noqa: BLE001 — structured-error
+            # contract: the serve-gateway CI leg greps for it
+            gateway_compare = {"error": f"{type(e).__name__}: {e}"}
+            errors.append(str(e))
+
     best = k_sweep[-1]["results"][-1]
     record = {
         "metric": "serve engine offered-load sweep (device-resident "
@@ -2871,6 +3068,8 @@ def bench_serve(args):
         record["elastic_compare"] = elastic_compare
     if migration_compare is not None:
         record["migration_compare"] = migration_compare
+    if gateway_compare is not None:
+        record["gateway_compare"] = gateway_compare
     if errors:
         record["error"] = "; ".join(errors)
     return record
@@ -3037,6 +3236,18 @@ def main():
                          "50% of what replay re-decoded, all asserted "
                          "(docs/SERVING.md 'Live migration & "
                          "disaggregated roles')")
+    ap.add_argument("--serve_gateway", action="store_true",
+                    help="bench_serve: run the gateway_compare leg — "
+                         "two 2-cell fleets route the same repeated-"
+                         "prompt workload with prefix-affinity vs "
+                         "hash-blind least-loaded (affinity's fleet-"
+                         "wide prefix-hit rate must be strictly "
+                         "higher), then the tenant_flood fault row "
+                         "drives an abusive tenant against a weight-2 "
+                         "victim on a shared fleet: typed 429s for the "
+                         "abuser, zero dropped requests, victim p95 "
+                         "within 1.5x its unloaded baseline, all "
+                         "asserted (docs/SERVING.md 'Gateway tier')")
     ap.add_argument("--transport", choices=("pipe", "socket"),
                     default="pipe",
                     help="bench_serve with --isolation process: "

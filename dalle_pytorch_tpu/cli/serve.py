@@ -290,6 +290,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "believable again)")
     p.add_argument("--autoscale_interval_s", type=float, default=1.0,
                    help="autoscaler: seconds between policy ticks")
+    p.add_argument("--gateway", action="store_true",
+                   help="run the multi-cell gateway tier: --cells "
+                        "independent InferenceServers ('cells', each "
+                        "with its own --replicas/--kv/... as configured "
+                        "here) behind one HTTP surface with prefix-"
+                        "affinity routing, per-tenant quotas, weighted-"
+                        "fair queueing, and hedged sends "
+                        "(docs/SERVING.md 'Gateway tier')")
+    p.add_argument("--cells", type=int, default=2,
+                   help="gateway mode: number of cells (each one full "
+                        "InferenceServer / ReplicaSet)")
+    p.add_argument("--tenants", type=str, default="",
+                   help="gateway mode: path to the tenant JSON (list of "
+                        "{name, key, weight, rps, image_tokens_per_s, "
+                        "max_pages, tier}); hot-reloadable via the "
+                        "authenticated POST /admin/tenants. Empty = "
+                        "anonymous single-tenant gateway (no auth, no "
+                        "quotas)")
     p.add_argument("--host", type=str, default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--metrics", type=str, default="",
@@ -402,8 +420,9 @@ def main(argv=None):
         # this combination is gone
         say(f"worker_ckpt: workers apply use_ema={args.use_ema} "
             f"quantize={args.quantize} after their local load")
-    server = InferenceServer(
-        params, vae_params, cfg, num_slots=args.num_slots,
+    def build_server():
+        return InferenceServer(
+            params, vae_params, cfg, num_slots=args.num_slots,
         queue_depth=args.queue_depth, chunk_steps=args.chunk_steps,
         prefill_buckets=buckets,
         quantize_cache=args.quantize == "int8_kv",
@@ -435,6 +454,48 @@ def main(argv=None):
         profile_dir=args.profile_dir or None,
         init_deadline_s=args.init_deadline_s,
         init_retries=args.init_retries).start()
+
+    if args.gateway:
+        # the fleet-of-fleets tier: N independent cells behind one
+        # prefix-affine, tenant-aware front door (docs/SERVING.md
+        # "Gateway tier")
+        from dalle_pytorch_tpu.serve.gateway import (
+            Gateway, serve_gateway_http)
+        from dalle_pytorch_tpu.serve.kv_pool import pages_for
+        from dalle_pytorch_tpu.serve.tenancy import TenantTable
+        if args.autoscale:
+            raise SystemExit(
+                "--gateway does not compose with --autoscale: each "
+                "cell would need its own policy; run cells directly "
+                "to autoscale them")
+        n_cells = max(args.cells, 1)
+        cells = [build_server() for _ in range(n_cells)]
+        tenants = TenantTable.from_file(args.tenants) \
+            if args.tenants else None
+        page_size = args.page_size or 16
+        gw = Gateway(
+            cells, tenants=tenants, cfg=cfg,
+            model_version=f"{args.name}_dalle@{args.dalle_epoch}",
+            quantized=args.quantize == "int8_kv",
+            queue_depth=args.queue_depth,
+            max_prompt_len=cfg.text_seq_len,
+            # a request's worst-case fleet-wide page residency: its
+            # whole padded sequence, the unit the tenant page budgets
+            # meter (dense cells still meter the equivalent)
+            pages_per_request=pages_for(cfg.seq_len, page_size),
+            admin_token=args.admin_token or None).start()
+        tenant_desc = (f", tenants {sorted(tenants.names())}"
+                       if tenants is not None else ", anonymous tenant")
+        say(f"gateway over {n_cells} cells ({args.replicas} replica(s) "
+            f"x {args.num_slots} slots each) on "
+            f"http://{args.host}:{args.port}{tenant_desc}")
+        say(f"admin: POST /admin/tenants with Authorization: Bearer "
+            f"{gw.admin_token} hot-reloads the tenant table; "
+            f"GET /stats /metrics /tenants for the fleet surface")
+        serve_gateway_http(gw, args.host, args.port)
+        return
+
+    server = build_server()
     kv_desc = args.kv if args.kv == "dense" \
         else f"{args.kv}/{args.paged_attn}" \
         + ("/sparse_reads" if args.sparse_reads else "") \

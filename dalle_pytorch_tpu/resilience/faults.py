@@ -43,6 +43,14 @@ Simulated faults (pytest -m faults exercises each):
       and the target rejecting the import with page exhaustion (the
       supervisor falls back to the next target or replay; the request
       completes byte-identically either way).
+  * GATEWAY faults (serve/gateway.py)   -> on_gateway_dispatch /
+      gateway_flood
+      a whole cell (one ReplicaSet behind the gateway) dying the
+      instant a request was routed to it — the gateway must fence the
+      cell and re-route + replay every in-flight request it held on
+      another cell, zero loss — and a synthetic abusive tenant
+      (tenant_flood) whose burst the isolation bench drives while a
+      victim tenant's p95 must stay within tolerance.
   * NETWORK faults (socket transport)   -> on_worker_chunk
       connection reset mid-frame (RST after half a frame), torn frame
       (half a frame then FIN), stalled socket (open but silent),
@@ -176,6 +184,21 @@ class FaultPlan:
     # once per activation.
     migrate_crash_source_at_transfer: int = -1
     migrate_reject_target: int = -1
+    # GATEWAY faults (serve/gateway.py, the multi-cell front door):
+    #   * gateway_cell_down_at_request: once the gateway has ROUTED
+    #     this many requests (cumulative across cells), the cell that
+    #     received the latest one dies whole — every engine behind it —
+    #     mid-stream; the gateway must fence the cell and re-route +
+    #     replay everything it held on a surviving cell, zero loss;
+    #   * tenant_flood / tenant_flood_requests: name a synthetic
+    #     abusive tenant and its burst size — the isolation bench reads
+    #     the spec via ``gateway_flood()`` (fire-once) and slams the
+    #     gateway under that tenant's key while asserting the victim
+    #     tenant's p95 and the typed 429 contract.
+    # -1/"" = off; both fire at most once per activation.
+    gateway_cell_down_at_request: int = -1
+    tenant_flood: str = ""
+    tenant_flood_requests: int = 0
 
 
 _active: Optional[FaultPlan] = None
@@ -564,6 +587,33 @@ def on_canary_gate(replica: int, version: str) -> None:
     raise FaultInjected(
         f"injected canary health-gate failure (replica {replica}, "
         f"version {version!r})")
+
+
+def on_gateway_dispatch(dispatched: int) -> bool:
+    """Called by the gateway AFTER each routing decision, with the
+    cumulative count of requests routed so far. Returns True exactly
+    once, when ``gateway_cell_down_at_request`` is reached — the
+    gateway then kills the whole cell the latest request landed on
+    (mid-stream for everything it holds) and must recover via fence +
+    re-route + replay on a survivor, zero loss."""
+    p = _active
+    if p is None or p.gateway_cell_down_at_request < 0:
+        return False
+    return dispatched >= p.gateway_cell_down_at_request \
+        and _once("gateway_cell_down")
+
+
+def gateway_flood() -> Optional[dict]:
+    """Fire-once spec for the synthetic abusive tenant: ``{"tenant":
+    name, "requests": burst}`` when ``tenant_flood`` is set, else None.
+    The isolation bench/test drives the flood itself (the gateway has
+    no business submitting requests); the plan is the reproducible
+    record of WHO flooded and HOW hard."""
+    p = _active
+    if p is None or not p.tenant_flood or not _once("tenant_flood"):
+        return None
+    return {"tenant": str(p.tenant_flood),
+            "requests": int(p.tenant_flood_requests)}
 
 
 def on_replica_bringup(replica: int, attempt: int) -> None:

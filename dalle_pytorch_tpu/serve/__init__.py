@@ -5,15 +5,20 @@ Import surface kept lazy-friendly: ``scheduler`` pulls no jax, so queue
 types (Request/Result/QueueFull) are importable before a backend exists —
 the same discipline as ``resilience`` (utils/metrics.py note)."""
 
+from dalle_pytorch_tpu.serve.auth import (  # noqa: F401
+    check_http, check_token, http_token)
 from dalle_pytorch_tpu.serve.kv_pool import (  # noqa: F401
     PageAllocator, PagePoolExhausted, PageReleaseUnderflow, pages_for)
 from dalle_pytorch_tpu.serve.prefix_cache import (  # noqa: F401
-    PrefixEntry, PrefixIndex, prefix_key)
+    PrefixEntry, PrefixIndex, content_key, prefix_key)
 from dalle_pytorch_tpu.serve.scheduler import (  # noqa: F401
     CANCELLED, DEADLINE_EXCEEDED, ERROR, OK, REJECTED, InvalidRequest,
     QueueClosed, QueueFull, Request, RequestHandle, RequestQueue, Result,
-    SamplingParams, ServeRejected, bucket_for, group_by_bucket,
-    prefill_buckets)
+    SamplingParams, ServeRejected, WeightedFairQueue, bucket_for,
+    group_by_bucket, prefill_buckets)
+from dalle_pytorch_tpu.serve.tenancy import (  # noqa: F401
+    TIERS, AuthError, TenantSpec, TenantTable, TenantThrottled,
+    TokenBucket)
 
 
 def __getattr__(name):
@@ -39,4 +44,10 @@ def __getattr__(name):
     if name in ("InferenceServer", "make_http_server", "serve_http"):
         from dalle_pytorch_tpu.serve import server
         return getattr(server, name)
+    if name in ("Gateway", "Cell", "make_gateway_http_server",
+                "serve_gateway_http"):
+        # gateway.py itself is jax-free, but it imports the faults /
+        # obs stack — defer it with the heavy modules anyway
+        from dalle_pytorch_tpu.serve import gateway
+        return getattr(gateway, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -66,6 +66,22 @@ def prefix_key(codes: Sequence[int], *, model_version: str,
     return h.hexdigest()
 
 
+def content_key(codes: Sequence[int], *, cfg, model_version: str,
+                quantized: bool = False) -> str:
+    """The prompt's content address computed FROM the model config —
+    the gateway's routing key. This is the SAME key an engine with this
+    (cfg, model_version, dtype) computes at admission, which is the
+    whole point of prefix-affinity routing: the rendezvous hash over
+    this key sends a repeated prompt to the cell whose PrefixIndex
+    already holds the entry it names. Accepts either the transformer
+    config or a DALLEConfig wrapping one (the engine signs
+    ``cfg.transformer``)."""
+    return prefix_key(codes, model_version=model_version,
+                      layer_sig=layer_signature(
+                          getattr(cfg, "transformer", cfg)),
+                      quantized=quantized)
+
+
 class PrefixEntry:
     """One cached prompt span. ``full_pages`` are the physical ids of
     the pages wholly below ``t0`` (the index holds one reference on

@@ -2263,6 +2263,13 @@ class ReplicaSet:
             "reclaimed": self.reclaimed,
             "bringup_failures": self.bringup_failures,
             "evicted": self._agg("evicted"),
+            # the cell-stats surface: fleet-wide prefix reuse for this
+            # set, aggregated across replicas (retired ones included) —
+            # what the gateway's affinity bench reads per CELL
+            "prefix_hits": self._agg("prefix_hits"),
+            "prefix_entries": sum(
+                len(r.engine.prefix) for r in live
+                if getattr(r.engine, "prefix", None) is not None),
             # the elastic surface: current generation, reshape
             # counters, and whether a rolling upgrade owns the fleet
             "weights_version": self.weights_version,

@@ -130,13 +130,17 @@ class Request:
     admits a cond/uncond slot pair and image tokens sample from
     ``l_u + cfg_scale * (l_c - l_u)``, exactly ``generate_images``'
     ``guidance`` knob (1.0 reduces to conditional sampling but still
-    pays the pair; 0, the default, is off)."""
+    pays the pair; 0, the default, is off). ``tenant`` names the
+    admitting tenant for weighted-fair queueing and per-tenant
+    accounting — ``""`` (the default) is the anonymous tenant, which
+    keeps single-tenant deployments byte-identical to before."""
     codes: Tuple[int, ...]
     seed: int = 0
     sampling: SamplingParams = SamplingParams()
     priority: int = 0                    # lower runs first
     deadline_s: Optional[float] = None   # relative to submit time
     cfg_scale: float = 0.0               # classifier-free guidance
+    tenant: str = ""                     # admitting tenant (gateway)
     request_id: int = -1                 # assigned by the queue
     submit_t: float = 0.0                # perf_counter, set by the queue
 
@@ -171,6 +175,7 @@ class Request:
             "deadline_left_s": (None if self.deadline_s is None
                                 else max(self.deadline_t - now, 0.0)),
             "cfg_scale": float(self.cfg_scale),
+            "tenant": str(self.tenant),
         }
 
     @classmethod
@@ -192,6 +197,8 @@ class Request:
             # .get: frames from a pre-guidance peer simply decode as
             # unguided instead of failing the whole attach
             cfg_scale=float(d.get("cfg_scale", 0.0)),
+            # .get: pre-tenancy frames decode as the anonymous tenant
+            tenant=str(d.get("tenant", "")),
             request_id=int(d["id"]),
             submit_t=float(now))
 
@@ -310,6 +317,13 @@ class RequestHandle:
         # None = unpinned (fresh request, or pin released because the
         # version left the fleet entirely — see replica._route).
         self.replay_version: Optional[str] = None
+        # weighted-fair queueing tags (WeightedFairQueue): the virtual
+        # start/finish stamps assigned ONCE at submit and reused by
+        # every requeue — a request's place in the fair order, like its
+        # queue_seq, must survive eviction/failover replay unchanged or
+        # determinism (and the no-starvation argument) breaks
+        self.vstart: Optional[float] = None
+        self.vfinish: Optional[float] = None
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -401,6 +415,20 @@ class RequestQueue:
         with self._lock:
             return len(self._heap)
 
+    def _order_key(self, handle: RequestHandle):
+        """The heap's primary sort key for one handle, computed under
+        ``_lock``. The base queue orders by priority alone (FIFO within
+        a class via ``queue_seq``, the tuple's second element);
+        ``WeightedFairQueue`` overrides this with (priority, virtual
+        finish time). MUST be stable across requeues of the same handle
+        — a request's place in line is part of the replay contract."""
+        return handle.request.priority
+
+    def _on_pop(self, handle: RequestHandle) -> None:
+        """Hook called under ``_lock`` for each handle handed to the
+        engine by ``pop_ready`` — ``WeightedFairQueue`` advances the
+        system virtual clock here. Base queue: no-op."""
+
     def close(self) -> None:
         """Gate further ``submit``s (typed ``QueueClosed``). Set BEFORE
         the shutdown drain so a submit racing ``close()`` cannot land in
@@ -447,8 +475,8 @@ class RequestQueue:
             otrace.attach(handle, rid, now).span(
                 "submit", now, priority=int(request.priority),
                 prompt_len=len(request.codes))
-            heapq.heappush(self._heap,
-                           (request.priority, handle.queue_seq, handle))
+            heapq.heappush(self._heap, (self._order_key(handle),
+                                        handle.queue_seq, handle))
             return handle
 
     def requeue(self, handle: RequestHandle, count: bool = True) -> None:
@@ -489,7 +517,7 @@ class RequestQueue:
                 return
             if count:
                 self.requeued += 1
-            heapq.heappush(self._heap, (handle.request.priority,
+            heapq.heappush(self._heap, (self._order_key(handle),
                                         handle.queue_seq, handle))
 
     def pop_ready(self, n: int,
@@ -514,7 +542,9 @@ class RequestQueue:
                 heapq.heapify(keep)
                 self._heap = keep
             while self._heap and len(ready) < n:
-                ready.append(heapq.heappop(self._heap)[2])
+                popped = heapq.heappop(self._heap)[2]
+                self._on_pop(popped)
+                ready.append(popped)
         return ready, [e[2] for e in dead]
 
     def pending_prompt_lens(self) -> List[int]:
@@ -544,3 +574,75 @@ class RequestQueue:
             out = [h for _, _, h in self._heap]
             self._heap.clear()
         return out
+
+
+class WeightedFairQueue(RequestQueue):
+    """Start-time fair queueing (SFQ) across tenants, generalizing the
+    base queue's arrival-position machinery to per-tenant VIRTUAL time.
+
+    Each tenant ``i`` with weight ``w_i`` keeps a running finish tag;
+    a request costing ``c`` (default 1.0 — fair in requests; pass
+    ``cost_fn`` for fair-in-image-tokens) is stamped at submit with
+
+        vstart  = max(V, F_i)          # V = system virtual time
+        vfinish = vstart + c / w_i     # F_i := vfinish
+
+    and the heap drains by (priority, vfinish, queue_seq): strict
+    priority classes still dominate (the base queue's contract), and
+    WITHIN a class tenants share throughput in proportion to their
+    weights — a weight-2 tenant's tags advance half as fast as a
+    weight-1 tenant's, so under saturation it drains twice the work.
+    ``V`` advances to the popped request's vstart, and the ``max(V,
+    F_i)`` clamp is both fairness directions at once: a tenant idle
+    while others ran resumes at ``V`` (no banked credit from the past),
+    and a tenant whose backlog pushed ``F_i`` far ahead of ``V`` owes
+    nothing once it drains — next submit after ``V`` catches up starts
+    at ``V``. No permanent debt, no permanent credit.
+
+    Tags are stamped ONCE (cached on the handle) so a requeue —
+    eviction, page-deferral, failover replay — re-enters at the
+    request's ORIGINAL virtual position, exactly as ``queue_seq``
+    preserves arrival order in the base queue. Determinism of replay
+    and the no-starvation argument are inherited unchanged."""
+
+    def __init__(self, max_depth: int = 64,
+                 max_prompt_len: Optional[int] = None,
+                 clock: Callable[[], float] = time.perf_counter,
+                 on_event=None,
+                 weight_of: Optional[Callable[[str], float]] = None,
+                 cost_fn: Optional[Callable[[Request], float]] = None):
+        super().__init__(max_depth=max_depth,
+                         max_prompt_len=max_prompt_len,
+                         clock=clock, on_event=on_event)
+        self.weight_of = weight_of if weight_of is not None \
+            else (lambda tenant: 1.0)
+        self.cost_fn = cost_fn if cost_fn is not None \
+            else (lambda request: 1.0)
+        self._vtime = 0.0
+        self._ftime: Dict[str, float] = {}
+
+    def _order_key(self, handle: RequestHandle):
+        if handle.vfinish is None:       # stamp once, at first insert
+            tenant = handle.request.tenant
+            weight = max(float(self.weight_of(tenant)), 1e-9)
+            vstart = max(self._vtime, self._ftime.get(tenant, 0.0))
+            handle.vstart = vstart
+            handle.vfinish = vstart + \
+                float(self.cost_fn(handle.request)) / weight
+            self._ftime[tenant] = handle.vfinish
+        return (handle.request.priority, handle.vfinish)
+
+    def _on_pop(self, handle: RequestHandle) -> None:
+        if handle.vstart is not None:
+            self._vtime = max(self._vtime, handle.vstart)
+
+    def virtual_time(self) -> float:
+        with self._lock:
+            return self._vtime
+
+    def finish_tag(self, tenant: str) -> float:
+        """The tenant's last virtual finish tag (0.0 if never seen) —
+        the observability hook the starvation tests pin: a tag at or
+        below ``virtual_time()`` means the tenant carries no debt."""
+        with self._lock:
+            return self._ftime.get(tenant, 0.0)

@@ -411,7 +411,8 @@ class InferenceServer:
                filter_thres: float = 0.5, top_p: float = 0.0,
                priority: int = 0,
                deadline_s: Optional[float] = None,
-               cfg_scale: Optional[float] = None) -> S.RequestHandle:
+               cfg_scale: Optional[float] = None,
+               tenant: str = "") -> S.RequestHandle:
         """Enqueue one generation request. Raises a typed, structured
         ``scheduler.ServeRejected`` subclass: ``QueueFull`` on
         backpressure, ``InvalidRequest`` for an empty or over-long
@@ -427,7 +428,7 @@ class InferenceServer:
                                       filter_thres=filter_thres,
                                       top_p=top_p),
             priority=priority, deadline_s=deadline_s,
-            cfg_scale=float(cfg_scale)))
+            cfg_scale=float(cfg_scale), tenant=str(tenant)))
 
     def generate(self, codes, timeout: Optional[float] = None,
                  **kwargs) -> S.Result:
@@ -858,13 +859,9 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             X-Admin-Token), 409 with the structured record for a typed
             ScaleError/UpgradeAborted — an illegal transition is a
             refusal the operator can read, never a partial state."""
-            import hmac as _hmac
-
+            from dalle_pytorch_tpu.serve import auth
             from dalle_pytorch_tpu.serve import replica as R
-            auth = self.headers.get("Authorization", "")
-            token = auth[7:] if auth.startswith("Bearer ") \
-                else (self.headers.get("X-Admin-Token") or "")
-            if not _hmac.compare_digest(token, server.admin_token):
+            if not auth.check_http(self.headers, server.admin_token):
                 self._send(401, {"error": "bad admin token"})
                 return
             try:
@@ -893,13 +890,9 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             capture is already active (or the target can't be
             profiled) — kernel tuning on a real chip is one curl away,
             and two operators can't trample each other's traces."""
-            import hmac as _hmac
-
+            from dalle_pytorch_tpu.serve import auth
             from dalle_pytorch_tpu.serve.engine import ProfileError
-            auth = self.headers.get("Authorization", "")
-            token = auth[7:] if auth.startswith("Bearer ") \
-                else (self.headers.get("X-Admin-Token") or "")
-            if not _hmac.compare_digest(token, server.admin_token):
+            if not auth.check_http(self.headers, server.admin_token):
                 self._send(401, {"error": "bad admin token"})
                 return
             try:

@@ -1,0 +1,367 @@
+"""Tenant model for the gateway tier: identity, quotas, fair shares.
+
+A TENANT is the unit of isolation at the front door. Each one carries:
+
+  * an API key — verified constant-time (serve/auth.py) at every
+    submit; an unknown or wrong key is a typed 401, never a silent
+    default tenant;
+  * token buckets — ``rps`` (requests/s) and ``image_tokens_per_s``
+    (decode work/s): the cheap, instantaneous half of isolation. A
+    bucket refusal is a typed 429 carrying ``retry_after_s`` — the
+    degradation contract's "abusive tenant exhausts only its own
+    quota" is enforced here, before the shared queue sees the request;
+  * a page budget — ``max_pages`` caps the tenant's in-flight mapped
+    KV pages FLEET-WIDE (reserved at admission, released at the
+    terminal fulfil): rate limits bound arrival, the page budget
+    bounds residency, and only both together bound HBM;
+  * a weight — its share of the fair queue (scheduler.py's
+    ``WeightedFairQueue``) under saturation;
+  * an SLO tier — maps to the hedge threshold (gateway.py): how long a
+    request may sit un-fulfilled before it is speculatively re-routed
+    to a second cell.
+
+The table hot-reloads (``reload``): bucket levels and in-flight page
+counts survive for tenants that persist across the reload, so an
+operator edit cannot be used to wash away a tenant's spent budget.
+
+Module-level imports are jax-free (the serve package's lazy-import
+discipline) — the gateway's admission path never touches a device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dalle_pytorch_tpu.serve import auth
+from dalle_pytorch_tpu.serve import scheduler as S
+from dalle_pytorch_tpu.utils.metrics import structured_event
+
+# SLO tiers: tier name -> default hedge threshold in seconds. A request
+# un-fulfilled past the threshold gets a speculative duplicate on a
+# second cell (gateway.py "hedged sends"); ``None`` never hedges.
+TIERS: Dict[str, Optional[float]] = {
+    "gold": 2.0,
+    "silver": 8.0,
+    "bronze": None,
+}
+
+
+class AuthError(S.ServeRejected):
+    """Typed authentication failure (HTTP 401): unknown API key, or a
+    key that fails the constant-time compare. Carries the standard
+    structured-event record; the gateway HTTP facade maps it to 401."""
+
+
+class TenantThrottled(S.ServeRejected):
+    """Typed per-tenant quota refusal (HTTP 429). ``record`` is a
+    ``tenant_throttled`` structured event with the tenant, which quota
+    tripped (``rps`` / ``image_tokens`` / ``pages``), and
+    ``retry_after_s`` — the machine-readable half of the degradation
+    contract (docs/SERVING.md "Gateway tier")."""
+
+    @property
+    def retry_after_s(self) -> float:
+        return float(self.record.get("retry_after_s", 0.0))
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's configured identity and limits, as loaded from the
+    ``--tenants`` JSON. Zero for a rate/budget means UNLIMITED — the
+    single-operator dev deployment is a one-tenant table with zeros."""
+    name: str
+    key: str = ""
+    weight: float = 1.0
+    rps: float = 0.0                  # requests per second (0 = no cap)
+    image_tokens_per_s: float = 0.0   # decode work per second
+    max_pages: int = 0                # fleet-wide in-flight page cap
+    tier: str = "bronze"
+    hedge_s: Optional[float] = None   # overrides the tier default
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("tenant name must be non-empty")
+        if self.weight <= 0:
+            raise ValueError(f"tenant {self.name!r}: weight must be "
+                             f"> 0, got {self.weight}")
+        if self.tier not in TIERS:
+            raise ValueError(f"tenant {self.name!r}: unknown tier "
+                             f"{self.tier!r} (have {sorted(TIERS)})")
+
+    @property
+    def hedge_after_s(self) -> Optional[float]:
+        return self.hedge_s if self.hedge_s is not None \
+            else TIERS[self.tier]
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantSpec":
+        return cls(
+            name=str(d["name"]),
+            key=str(d.get("key", "")),
+            weight=float(d.get("weight", 1.0)),
+            rps=float(d.get("rps", 0.0)),
+            image_tokens_per_s=float(d.get("image_tokens_per_s", 0.0)),
+            max_pages=int(d.get("max_pages", 0)),
+            tier=str(d.get("tier", "bronze")),
+            hedge_s=(None if d.get("hedge_s") is None
+                     else float(d["hedge_s"])))
+
+
+class TokenBucket:
+    """Classic token bucket: capacity ``burst``, refilled at ``rate``
+    per second. ``rate <= 0`` disables the limit entirely. ``take``
+    returns the retry-after in seconds — 0.0 means the tokens were
+    granted. Not thread-safe on its own; TenantTable's lock serializes
+    access."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        # default burst = 1s of rate, but never below one whole token
+        # (a rate of 0.5/s must still admit a single request at once)
+        self.burst = float(burst) if burst is not None \
+            else max(self.rate, 1.0)
+        self.clock = clock
+        self.level = self.burst
+        self._last = clock()
+
+    def _refill(self, now: float) -> None:
+        self.level = min(self.burst,
+                         self.level + (now - self._last) * self.rate)
+        self._last = now
+
+    def take(self, amount: float = 1.0) -> float:
+        """Try to take ``amount`` tokens. Returns 0.0 on success, else
+        the seconds until the bucket will hold ``amount`` again — the
+        429's ``Retry-After``. A refusal takes nothing (no partial
+        spend: a throttled request costs the tenant zero budget)."""
+        if self.rate <= 0:
+            return 0.0
+        now = self.clock()
+        self._refill(now)
+        if self.level >= amount:
+            self.level -= amount
+            return 0.0
+        return (amount - self.level) / self.rate
+
+
+class TenantState:
+    """One tenant's RUNTIME ledger: buckets, in-flight pages, counters.
+    Kept separate from the frozen spec so ``reload`` can swap specs
+    while the ledger — spent budget, live reservations — persists."""
+
+    def __init__(self, spec: TenantSpec,
+                 clock: Callable[[], float] = time.monotonic):
+        self.spec = spec
+        self.req_bucket = TokenBucket(spec.rps, clock=clock)
+        self.tok_bucket = TokenBucket(
+            spec.image_tokens_per_s,
+            # decode-work bursts are lumpy (one request = hundreds of
+            # image tokens): allow at least one full image per burst
+            burst=max(spec.image_tokens_per_s, 1024.0), clock=clock)
+        self.pages_in_flight = 0
+        self.admitted = 0
+        self.throttled = 0
+        self.completed = 0
+
+    def rebind(self, spec: TenantSpec) -> None:
+        """Hot-reload: adopt the new spec's limits without resetting
+        the ledger. Bucket LEVELS carry over (clamped to the new
+        burst); rates take effect immediately."""
+        self.spec = spec
+        self.req_bucket.rate = spec.rps
+        self.req_bucket.burst = max(spec.rps, 1.0)
+        self.req_bucket.level = min(self.req_bucket.level,
+                                    self.req_bucket.burst)
+        self.tok_bucket.rate = spec.image_tokens_per_s
+        self.tok_bucket.burst = max(spec.image_tokens_per_s, 1024.0)
+        self.tok_bucket.level = min(self.tok_bucket.level,
+                                    self.tok_bucket.burst)
+
+
+class TenantTable:
+    """The gateway's tenant registry: authentication, admission-time
+    quota checks, page-budget reservations, WFQ weights. Thread-safe —
+    the gateway's HTTP threads and pump thread share it."""
+
+    def __init__(self, specs: List[TenantSpec],
+                 clock: Callable[[], float] = time.monotonic,
+                 on_event=None):
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tenant names in {names}")
+        self.clock = clock
+        self.on_event = on_event
+        self._lock = threading.Lock()
+        self._states: Dict[str, TenantState] = {
+            s.name: TenantState(s, clock=clock) for s in specs}
+        self.reloads = 0
+
+    # -- construction -------------------------------------------------
+
+    @classmethod
+    def from_json(cls, data, **kw) -> "TenantTable":
+        """Build from the ``--tenants`` JSON shape: either a bare list
+        of tenant dicts or ``{"tenants": [...]}``."""
+        if isinstance(data, dict):
+            data = data.get("tenants", [])
+        if not isinstance(data, list):
+            raise ValueError("tenants JSON must be a list or "
+                             "{'tenants': [...]}")
+        return cls([TenantSpec.from_dict(d) for d in data], **kw)
+
+    @classmethod
+    def from_file(cls, path: str, **kw) -> "TenantTable":
+        with open(path) as f:
+            return cls.from_json(json.load(f), **kw)
+
+    # -- introspection ------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._states)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._states)
+
+    def spec(self, name: str) -> TenantSpec:
+        with self._lock:
+            return self._states[name].spec
+
+    def weight_of(self, name: str) -> float:
+        """WFQ weight lookup (scheduler.WeightedFairQueue's
+        ``weight_of``). Unknown names — e.g. the anonymous tenant on a
+        table that never defined one — weigh 1.0."""
+        with self._lock:
+            st = self._states.get(name)
+            return st.spec.weight if st is not None else 1.0
+
+    def stats(self) -> Dict[str, dict]:
+        with self._lock:
+            return {name: {
+                "weight": st.spec.weight,
+                "tier": st.spec.tier,
+                "admitted": st.admitted,
+                "throttled": st.throttled,
+                "completed": st.completed,
+                "pages_in_flight": st.pages_in_flight,
+                "max_pages": st.spec.max_pages,
+            } for name, st in self._states.items()}
+
+    # -- the admission path -------------------------------------------
+
+    def _event(self, kind: str, **fields) -> dict:
+        record = structured_event(kind, **fields)
+        if self.on_event is not None:
+            self.on_event(record)
+        return record
+
+    def authenticate(self, api_key: str) -> TenantSpec:
+        """Map an API key to its tenant, constant-time per candidate.
+        A tenant with an EMPTY configured key is open (matches the
+        empty api_key — dev tables); any other mismatch is a typed
+        ``AuthError``. Scanning all tenants (no early exit on a name
+        hint) keeps the caller's key the only input."""
+        with self._lock:
+            for st in self._states.values():
+                key = st.spec.key
+                if (key == "" and api_key == "") or \
+                        auth.check_token(api_key, key):
+                    return st.spec
+        raise AuthError(self._event(
+            "gateway_auth_failed", reason="unknown_api_key"))
+
+    def admit(self, tenant: str, *, image_tokens: int,
+              pages: int) -> None:
+        """All-or-nothing admission charge for one request: request
+        bucket, image-token bucket, and the page budget, checked in
+        that order with NO partial spend (a pages refusal refunds the
+        bucket takes). Raises ``TenantThrottled`` (typed 429) naming
+        the quota that tripped."""
+        with self._lock:
+            st = self._states.get(tenant)
+            if st is None:
+                raise AuthError(self._event(
+                    "gateway_auth_failed", reason="unknown_tenant",
+                    tenant=tenant))
+            retry = st.req_bucket.take(1.0)
+            if retry > 0.0:
+                st.throttled += 1
+                raise TenantThrottled(self._event(
+                    "tenant_throttled", tenant=tenant, quota="rps",
+                    retry_after_s=round(retry, 4)))
+            retry = st.tok_bucket.take(float(image_tokens))
+            if retry > 0.0:
+                st.req_bucket.level += 1.0     # refund the first take
+                st.throttled += 1
+                raise TenantThrottled(self._event(
+                    "tenant_throttled", tenant=tenant,
+                    quota="image_tokens",
+                    retry_after_s=round(retry, 4)))
+            if st.spec.max_pages > 0 and \
+                    st.pages_in_flight + pages > st.spec.max_pages:
+                st.req_bucket.level += 1.0
+                st.tok_bucket.level += float(image_tokens)
+                st.throttled += 1
+                raise TenantThrottled(self._event(
+                    "tenant_throttled", tenant=tenant, quota="pages",
+                    pages_in_flight=st.pages_in_flight,
+                    requested=pages, max_pages=st.spec.max_pages,
+                    # pages free as flights retire; one request-time is
+                    # the honest "try again" horizon we can promise
+                    retry_after_s=1.0))
+            st.pages_in_flight += pages
+            st.admitted += 1
+
+    def release(self, tenant: str, *, pages: int,
+                completed: bool = True) -> None:
+        """Return a terminal request's page reservation. Idempotence is
+        the CALLER's job (the gateway releases exactly once per flight,
+        keyed by request id); the floor clamp here only guards against
+        a release racing a reload that dropped and re-added the
+        tenant."""
+        with self._lock:
+            st = self._states.get(tenant)
+            if st is None:
+                return
+            st.pages_in_flight = max(0, st.pages_in_flight - pages)
+            if completed:
+                st.completed += 1
+
+    # -- hot reload ---------------------------------------------------
+
+    def reload(self, data) -> dict:
+        """Swap in a new tenant list (the authenticated admin
+        endpoint's hot path). Persisting tenants keep their runtime
+        ledger (``TenantState.rebind``); new tenants start fresh;
+        removed tenants' in-flight work completes under the gateway's
+        per-flight bookkeeping but no new work is admitted. Returns a
+        summary event record."""
+        if isinstance(data, dict):
+            data = data.get("tenants", [])
+        specs = [TenantSpec.from_dict(d) for d in data]
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tenant names in {names}")
+        with self._lock:
+            old = set(self._states)
+            states: Dict[str, TenantState] = {}
+            for spec in specs:
+                st = self._states.get(spec.name)
+                if st is not None:
+                    st.rebind(spec)
+                else:
+                    st = TenantState(spec, clock=self.clock)
+                states[spec.name] = st
+            self._states = states
+            self.reloads += 1
+            added = sorted(set(names) - old)
+            removed = sorted(old - set(names))
+        return self._event("gateway_tenants_reloaded",
+                           tenants=sorted(names), added=added,
+                           removed=removed)

@@ -39,7 +39,8 @@ against):
 THE PARENT (never the reverse — the parent may be behind the same
 firewall, and a dialing worker composes with hand-started remote
 workers), and the first frame on a new connection must be an
-authenticated HELLO: the shared token (``hmac.compare_digest``; ships
+authenticated HELLO: the shared token (serve/auth.py's constant-time
+``check_token``; ships
 via the ``DALLE_WORKER_TOKEN`` env var, never argv) plus the protocol
 version and the replica index the worker claims. A bad token, a version
 skew, or an unexpected index closes the connection without attaching
@@ -54,7 +55,6 @@ off the network.
 
 from __future__ import annotations
 
-import hmac
 import os
 import pickle
 import secrets
@@ -64,6 +64,8 @@ import struct
 import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
+
+from dalle_pytorch_tpu.serve import auth
 
 # the env var a hand-started / launcher-started worker reads its HELLO
 # token from — an env var, not argv, so the secret never shows in `ps`
@@ -506,8 +508,7 @@ class WorkerListener:
                                f"got {kind}/{seq}")
             token = payload.get("token")
             index = payload.get("index")
-            if not isinstance(token, str) or not hmac.compare_digest(
-                    token, self.token):
+            if not auth.check_token(token, self.token):
                 raise IPCError("HELLO rejected: bad token")
             if not isinstance(index, int):
                 raise IPCError("HELLO rejected: no index")
