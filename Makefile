@@ -36,9 +36,11 @@ demo-rehearsal:  ## end-to-end demo pipeline, tiny knobs, scratch dirs
 demo:            ## the real trained demo on the chip
 	bash scripts/tpu_demo.sh
 
-lint:            ## syntax check + jaxlint (the TPU-invariant AST rules)
+lint:            ## syntax check + jaxlint + racelint (AST rule gates)
 	$(CPU_ENV) python -m compileall -q dalle_pytorch_tpu tests scripts \
 	    bench.py __graft_entry__.py
 	for f in scripts/*.sh; do bash -n $$f || exit 1; done
 	$(CPU_ENV) python -m dalle_pytorch_tpu.analysis.jaxlint \
+	    dalle_pytorch_tpu tests scripts bench.py
+	$(CPU_ENV) python -m dalle_pytorch_tpu.analysis.racelint \
 	    dalle_pytorch_tpu tests scripts bench.py

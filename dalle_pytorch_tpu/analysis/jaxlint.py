@@ -39,14 +39,15 @@ from __future__ import annotations
 
 import argparse
 import ast
-import dataclasses
-import io
 import json
-import re
 import sys
-import tokenize
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import lintcore
+from .lintcore import (DEFAULT_EXCLUDES, Finding, iter_py_files,
+                       dotted as _dotted, last as _last,
+                       mod_parts as _mod_parts)
 
 # rule id -> (slug, one-line description). docs/STATIC_ANALYSIS.md holds
 # the long-form rationale; keep the two in sync.
@@ -95,48 +96,10 @@ _RNG_DERIVE = {"split", "fold_in", "PRNGKey", "key", "key_data",
                "wrap_key_data", "clone"}
 _SYNC_ATTRS = {"item", "tolist"}
 
-
-@dataclasses.dataclass(frozen=True)
-class Finding:
-    rule: str
-    path: str
-    line: int
-    col: int
-    message: str
-
-    @property
-    def slug(self) -> str:
-        return RULES[self.rule][0]
-
-    def to_dict(self) -> dict:
-        return {"rule": self.rule, "slug": self.slug, "path": self.path,
-                "line": self.line, "col": self.col,
-                "message": self.message}
-
-    def render(self) -> str:
-        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
-                f"({self.slug}) {self.message}")
-
-
-def _dotted(node: ast.AST) -> str:
-    """'jax.random.normal' for a Name/Attribute chain, '' otherwise."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
-
-
-def _last(node: ast.AST) -> str:
-    """Final component of a Name/Attribute chain ('' otherwise)."""
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return ""
+# Finding, the suppression parser, DEFAULT_EXCLUDES, and iter_py_files
+# live in lintcore and are shared with racelint; registering the rules
+# is what makes Finding.slug resolve for JL ids.
+lintcore.register_rules(RULES)
 
 
 def _is_jit_expr(node: ast.AST) -> bool:
@@ -342,53 +305,13 @@ class _ModuleIndex(ast.NodeVisitor):
 
 
 # ---------------------------------------------------------------------------
-# suppression comments
+# suppression comments (shared parser in lintcore)
 # ---------------------------------------------------------------------------
 
-_DISABLE_RE = re.compile(r"jaxlint:\s*disable=([A-Za-z0-9_,\-]+)")
-
-
 def _suppressions(src: str) -> Dict[int, Set[str]]:
-    """line -> set of suppressed rule ids. A trailing comment suppresses
-    its own line; a comment-only line also suppresses the next line (for
-    statements too long to share a line with their waiver)."""
-    slug_to_id = {slug: rid for rid, (slug, _) in RULES.items()}
-    out: Dict[int, Set[str]] = {}
-    try:
-        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
-    except tokenize.TokenizeError:
-        return out
-    code_lines = set()
-    for tok in tokens:
-        if tok.type == tokenize.COMMENT:
-            m = _DISABLE_RE.search(tok.string)
-            if not m:
-                continue
-            rules: Set[str] = set()
-            for part in m.group(1).split(","):
-                part = part.strip()
-                if part.lower() == "all":
-                    rules |= set(RULES)
-                elif part.upper() in RULES:
-                    rules.add(part.upper())
-                elif part in slug_to_id:
-                    rules.add(slug_to_id[part])
-            out.setdefault(tok.start[0], set()).update(rules)
-        elif tok.type not in (tokenize.NL, tokenize.NEWLINE,
-                              tokenize.INDENT, tokenize.DEDENT,
-                              tokenize.ENCODING, tokenize.ENDMARKER):
-            code_lines.add(tok.start[0])
-    max_line = max(code_lines, default=0)
-    for line in list(out):
-        if line in code_lines:
-            continue
-        # standalone waiver: skip the rest of its comment block and
-        # cover the first code line after it
-        nxt = line + 1
-        while nxt <= max_line and nxt not in code_lines:
-            nxt += 1
-        out.setdefault(nxt, set()).update(out[line])
-    return out
+    """line -> set of suppressed rule ids for `# jaxlint: disable=...`
+    comments (the shared lintcore parser scoped to this tool's tag)."""
+    return lintcore.suppressions(src, "jaxlint", RULES)
 
 
 # ---------------------------------------------------------------------------
@@ -991,10 +914,6 @@ def _check_wallclock(idx: _ModuleIndex, path: str, tree: ast.Module,
 # driver
 # ---------------------------------------------------------------------------
 
-# jaxlint's own true-positive test corpus must not fail the repo gate
-DEFAULT_EXCLUDES = ("fixtures/jaxlint",)
-
-
 def _run_checks(idx: _ModuleIndex, path: str,
                 tree: ast.Module) -> List[Finding]:
     findings: List[Finding] = []
@@ -1013,19 +932,7 @@ def _run_checks(idx: _ModuleIndex, path: str,
 
 
 def _filter(findings: List[Finding], src: str) -> List[Finding]:
-    supp = _suppressions(src)
-    findings = [f for f in findings
-                if f.rule not in supp.get(f.line, set())]
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    # two rules can hit one call site; keep the first per (line, col, rule)
-    seen: Set[Tuple] = set()
-    out = []
-    for f in findings:
-        k = (f.line, f.col, f.rule)
-        if k not in seen:
-            seen.add(k)
-            out.append(f)
-    return out
+    return lintcore.filter_findings(findings, src, "jaxlint", RULES)
 
 
 def lint_source(src: str, path: str = "<string>") -> List[Finding]:
@@ -1044,18 +951,6 @@ def lint_file(path: Path) -> List[Finding]:
 # ---------------------------------------------------------------------------
 # project mode: cross-module traced reachability (JL001/JL009)
 # ---------------------------------------------------------------------------
-
-def _mod_parts(path: str) -> Tuple[str, ...]:
-    """Dotted-module parts of a file path ('.../serve/engine.py' ->
-    (..., 'serve', 'engine')); a package's __init__.py is the package
-    itself."""
-    p = Path(path)
-    parts = list(p.parts)
-    parts[-1] = p.stem
-    if parts[-1] == "__init__":
-        parts.pop()
-    return tuple(parts)
-
 
 class _Unit:
     __slots__ = ("path", "src", "tree", "idx", "parts")
@@ -1156,21 +1051,6 @@ def lint_files(paths: Sequence[Path]) -> List[Finding]:
     (``main`` reports parse errors per file and lints the rest)."""
     return _lint_units([_Unit(str(p), p.read_text(encoding="utf-8"))
                         for p in paths])
-
-
-def iter_py_files(paths: Sequence[str],
-                  excludes: Sequence[str] = DEFAULT_EXCLUDES
-                  ) -> List[Path]:
-    out: List[Path] = []
-    for p in paths:
-        pp = Path(p)
-        if pp.is_dir():
-            out.extend(sorted(pp.rglob("*.py")))
-        elif pp.suffix == ".py":
-            out.append(pp)
-    return [p for p in out
-            if not any(ex in str(p) for ex in excludes)
-            and "__pycache__" not in str(p)]
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

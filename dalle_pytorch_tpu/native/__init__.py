@@ -46,6 +46,9 @@ def load_library(build_if_missing: bool = True):
         try:
             path = LIB
             if build_if_missing:
+                # racelint: disable=RL003 — the lock exists precisely to
+                # serialize this one-time compile (double-checked dlopen);
+                # nothing else contends on it during a build
                 path = build(quiet=True)  # no-op when fresh, rebuild if stale
             lib = ctypes.CDLL(path)
             lib.dtl_load_images.restype = ctypes.c_int

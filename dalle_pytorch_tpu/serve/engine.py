@@ -1945,6 +1945,9 @@ class Engine:
             # host's emitted list must describe the SAME point in the
             # stream, and no orphaned ring row may outlive the export
             while self._pending:
+                # racelint: disable=RL003 — deliberate: _lock IS the
+                # step serializer; an export must flush (and sync) under
+                # it or the snapshot tears against a concurrent step
                 self._harvest_chunk()
             slot = self.slots[i] if 0 <= i < self.num_slots else None
             if slot is None or slot.shadow_of is not None:
@@ -1954,9 +1957,12 @@ class Engine:
                 raise MigrationError("not_found",
                                      "request completed during export")
             now = self.clock()
-            (pos_h, tok_h, rng_h, temp_h, topk_h, topp_h) = \
-                jax.device_get((self.pos, self.cur_tok, self.rng,
-                                self.temp, self.topk_k, self.top_p))
+            # racelint: disable=RL003 — deliberate: the exported decode
+            # state must be fetched under the step serializer, or the
+            # snapshot tears against a concurrent step
+            snap = jax.device_get((self.pos, self.cur_tok, self.rng,
+                                   self.temp, self.topk_k, self.top_p))
+            (pos_h, tok_h, rng_h, temp_h, topk_h, topp_h) = snap
 
             def rows(j):
                 return {"pos": int(pos_h[j]), "cur_tok": int(tok_h[j]),
@@ -2212,6 +2218,11 @@ class Engine:
                 # block on a compile (see _admitting)
                 self._admitting = list(ready)
                 try:
+                    # racelint: disable=RL003 — deliberate: admission
+                    # compiles/donates into live slot buffers; it MUST
+                    # run under the step serializer (_lock), and the
+                    # reclaim sweep uses a timed acquire + _admitting
+                    # precisely so a slow compile cannot wedge it
                     self._admit(ready, now)
                 finally:
                     self._admitting = []
@@ -2228,6 +2239,10 @@ class Engine:
             # new is dispatched (pool drained), flush the pipeline.
             target = 1 if dispatched else 0
             while len(self._pending) > target:
+                # racelint: disable=RL003 — deliberate: the harvest
+                # device_get is THE step; _lock is the step serializer,
+                # and the double-buffer above already bounds the stall
+                # to one chunk
                 self._harvest_chunk()
                 did = True
 
@@ -2296,6 +2311,9 @@ class Engine:
             # clean shutdown with a capture in flight: stop the
             # process-global trace (partial but valid) on the way out
             self._profiler.close()
+            # racelint: disable=RL001 — _profiler is run-loop-thread-
+            # private (armed via the _profile_req handoff); this is the
+            # loop's own epilogue, no other thread ever writes it
             self._profiler = None
 
     def _terminate_active(self, status: str, reason: str) -> int:

@@ -507,9 +507,13 @@ class ReplicaSet:
         self._ctl_stop = threading.Event()
         self._t_start: Optional[float] = None
 
-        now = self.clock()
-        for r in self.replicas:
-            self._bring_up(r, now)
+        # no other thread exists yet, but _bring_up mutates set-level
+        # counters (bringup_failures) that every later call site guards
+        # with _ctl_lock — keep the discipline uniform from the start
+        with self._ctl_lock:
+            now = self.clock()
+            for r in self.replicas:
+                self._bring_up(r, now)
 
     # -- events -------------------------------------------------------------
 
@@ -1104,6 +1108,11 @@ class ReplicaSet:
             self._reject_mid_upgrade("drain")
             r = self._replica_or_reject("drain", index)
             now = self.clock()
+            # racelint: disable=RL003 — deliberate: reshapes are
+            # serialized by _ctl_lock end-to-end; migration transfers
+            # (and the fault hooks that delay them in tests) run under
+            # it so no second reshape can observe a half-moved slot.
+            # The data plane (engine/queue locks) is not held here.
             moved = self._migrate_from(r, now, reason=reason)
             n = self._fence_and_reclaim(r, self.clock(), reason)
             r.state = DRAINED
@@ -1201,6 +1210,9 @@ class ReplicaSet:
                 raise self._scale_error("remove", replica=index,
                                         reason="remove_last_replica")
             now = self.clock()
+            # racelint: disable=RL003 — deliberate: scale-in migrates
+            # under _ctl_lock so the reshape is atomic against other
+            # control-plane ops; the data plane stays unlocked
             moved = self._migrate_from(r, now, reason=reason) \
                 if drain else 0
             n = self._fence_and_reclaim(r, self.clock(), reason)
@@ -1414,6 +1426,9 @@ class ReplicaSet:
                     # replica's (old) generation may take its work
                     # mid-stream — same-seed tokens are byte-identical
                     # per weights_version, not across them
+                    # racelint: disable=RL003 — deliberate: upgrade
+                    # migration runs under _ctl_lock like every other
+                    # reshape; see drain() for the full rationale
                     migrated = self._migrate_from(
                         r, self.clock(),
                         reason=f"rolling upgrade to {version}",
@@ -1521,7 +1536,13 @@ class ReplicaSet:
                         replicas=len(record["replicas"]))
             return record
         finally:
-            self._upgrading = False
+            # the flag was SET under _ctl_lock; clearing it unguarded
+            # would let a concurrent reshape read a half-written False
+            # interleaved with its own admission check (every with-
+            # block inside the try has unwound by here, so this cannot
+            # self-deadlock)
+            with self._ctl_lock:
+                self._upgrading = False
 
     # -- supervision --------------------------------------------------------
 
@@ -1922,6 +1943,9 @@ class ReplicaSet:
                     busy = self._pump_children(now)
                 busy = self._check_replicas(now) or busy
                 busy = self._route(now) or busy
+                # racelint: disable=RL003 — deliberate: role handoff is
+                # a reshape (warm prefill→decode migration) and runs
+                # under _ctl_lock like drain/scale-in/upgrade
                 busy = self._role_handoff(now) or busy
             stop.wait(0.0005 if busy else self._idle_sleep_s)
 
@@ -2017,6 +2041,8 @@ class ReplicaSet:
                 did = self._pump_children(now)
             did = self._check_replicas(now) or did
             did = self._route(now) or did
+            # racelint: disable=RL003 — deliberate: same reshape-under-
+            # _ctl_lock discipline as the driver loop above
             did = self._role_handoff(now) or did
         if self.isolation == "process":
             # the children step themselves; the parent's "step" is the

@@ -1,0 +1,22 @@
+"""Half A of the cross-module lock-order cycle. Alone this file lints
+CLEAN — ``PeerB`` is not defined here, so the call under ``_la`` cannot
+be resolved and contributes no edge. Only project mode, with
+``cross_order_b.py`` in the same run, sees ``PeerA._la -> PeerB._lb``
+meet its reverse and closes the cycle (anchored here, the first edge
+site in path order).
+"""
+
+import threading
+
+
+class PeerA:
+    def __init__(self):
+        self._la = threading.Lock()
+
+    def ping(self, b: "PeerB"):
+        with self._la:
+            b.pong_inner()          # cross-expect: RL002
+
+    def ping_inner(self):
+        with self._la:
+            pass

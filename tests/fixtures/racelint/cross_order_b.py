@@ -1,0 +1,20 @@
+"""Half B of the cross-module lock-order cycle — holds its own lock
+and calls back into ``PeerA`` (see cross_order_a.py). Clean alone for
+the same reason: the reverse edge only exists when both halves are in
+one project-mode run.
+"""
+
+import threading
+
+
+class PeerB:
+    def __init__(self):
+        self._lb = threading.Lock()
+
+    def pong_inner(self):
+        with self._lb:
+            pass
+
+    def pong(self, a: "PeerA"):
+        with self._lb:
+            a.ping_inner()

@@ -1,0 +1,87 @@
+"""RL001 true positives + must-not-flag idioms: lock-guard inference.
+
+The rule infers each attribute's guard from the writes themselves — if
+SOME writes to ``self.x`` happen under an own-instance lock and others
+under none, the unguarded sites are data-race candidates. ``__init__``
+writes never count (no other thread can hold a reference yet), and a
+private helper only ever CALLED under the lock is guarded too (the
+entry-held fixpoint), so the serve tier's ``_reject``-style helpers
+stay clean.
+"""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.total = 0
+        self.label = ""
+        self.backlog = []
+
+    def record(self, n):
+        with self._lock:
+            self.hits += 1
+            self.total += n
+            self.backlog.append(n)
+
+    def reset(self):
+        self.hits = 0       # expect: RL001
+        self.total = 0      # expect: RL001
+
+    def enqueue_racy(self, n):
+        self.backlog.append(n)      # expect: RL001
+
+    # must not flag: no write to `label` ever happens under a lock, so
+    # there is no inferred guard to violate (single-writer by design)
+    def rename(self, label):
+        self.label = label
+
+    # must not flag: the write in _apply is lexically bare, but _apply
+    # is only ever called with the lock held — the entry-held fixpoint
+    # marks it guarded
+    def flush(self):
+        with self._lock:
+            self._apply()
+
+    def _apply(self):
+        self.hits = 0
+        self.total = 0
+
+
+class Upgrader:
+    """Regression shape: replica.rolling_upgrade set the in-progress
+    flag under the control lock but cleared it bare in its ``finally``
+    block — exactly the asymmetry this rule exists to catch."""
+
+    def __init__(self):
+        self._ctl = threading.Lock()
+        self._upgrading = False
+
+    def rolling(self):
+        with self._ctl:
+            self._upgrading = True
+        try:
+            self._step()
+        finally:
+            self._upgrading = False     # expect: RL001
+
+    def _step(self):
+        pass
+
+
+class EventHolder:
+    """Must not flag: threading.Event/queue.Queue/Thread attributes are
+    their own synchronization — writes to them are excluded."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def arm(self):
+        with self._lock:
+            self._stop = threading.Event()
+
+    def rearm_bare(self):
+        self._stop = threading.Event()

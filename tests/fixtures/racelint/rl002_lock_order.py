@@ -1,0 +1,113 @@
+"""RL002 true positives + must-not-flag idioms: lock ordering.
+
+Two halves. (1) A cycle in the whole-program acquires-while-holding
+graph — here within one file, through method calls on typed receivers —
+is a potential deadlock; the single finding anchors at the cycle's
+first edge site. (2) A lexical reentrant acquire of a non-reentrant
+``threading.Lock`` the same thread already holds is a certain deadlock.
+Timed acquires (``acquire(timeout=...)``) are excluded from the cycle
+graph: a bounded wait cannot wedge, it fails over.
+"""
+
+import threading
+
+
+class Alpha:
+    """Cycle regression shape: the replica control plane holds its lock
+    and reaches into the engine, while an engine-side path reaches back
+    into the control plane — each direction alone is fine, together
+    they deadlock under load."""
+
+    def __init__(self):
+        self._la = threading.Lock()
+
+    def forward(self):
+        b = Beta()
+        with self._la:
+            b.backward_inner()      # expect: RL002
+
+    def finish_inner(self):
+        with self._la:
+            pass
+
+
+class Beta:
+    def __init__(self):
+        self._lb = threading.Lock()
+
+    def backward_inner(self):
+        with self._lb:
+            pass
+
+    def reverse(self):
+        a = Alpha()
+        with self._lb:
+            a.finish_inner()        # the other half of the cycle
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rlock = threading.RLock()
+
+    def double_acquire(self):
+        with self._lock:
+            with self._lock:        # expect: RL002
+                pass
+
+    # must not flag: RLock is reentrant — same-thread re-acquire is
+    # exactly what it is for
+    def reentrant_ok(self):
+        with self._rlock:
+            with self._rlock:
+                pass
+
+
+class Gamma:
+    def __init__(self):
+        self._lg = threading.Lock()
+
+    def ordered(self, d: "Delta"):
+        with self._lg:
+            d.touch_inner()
+
+
+class Delta:
+    def __init__(self):
+        self._ld = threading.Lock()
+
+    def touch_inner(self):
+        with self._ld:
+            pass
+
+
+def also_ordered(g: Gamma, d: Delta):
+    # must not flag: both paths take Gamma._lg BEFORE Delta._ld — one
+    # consistent direction is the fix for a cycle, not an instance of it
+    with g._lg:
+        with d._ld:
+            pass
+
+
+class Sweeper:
+    """Must not flag: the replica reclaim-sweep idiom — the reverse
+    direction exists but uses a TIMED acquire precisely so a wedged
+    peer cannot wedge the sweep; timed edges stay out of the cycle."""
+
+    def __init__(self):
+        self._ctl = threading.Lock()
+
+    def sweep(self, e: "EngineLike"):
+        with self._ctl:
+            if e._elock.acquire(timeout=0.2):
+                e._elock.release()
+
+
+class EngineLike:
+    def __init__(self):
+        self._elock = threading.Lock()
+
+    def steplike(self, s: Sweeper):
+        with self._elock:
+            with s._ctl:
+                pass

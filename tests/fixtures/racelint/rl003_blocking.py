@@ -1,0 +1,81 @@
+"""RL003 true positives + must-not-flag idioms: blocking under a lock.
+
+A blocking operation — transport/socket I/O, sleep, select, subprocess,
+an unbounded ``get()``/``join()``/``wait()``, a host-device sync —
+reached while a lock is held stalls every thread contending on that
+lock. The finding lands where the lock is LEXICALLY held: a helper
+that sleeps is fine on its own, the caller that invokes it under a
+lock owns the hazard.
+"""
+
+import queue
+import subprocess
+import threading
+import time
+
+
+class Transport:
+    """Regression shape: the live-migration path shipped KV pages to a
+    peer while holding the control lock — one stalled peer froze every
+    control-plane operation in the fleet (fixed by moving the send
+    outside the critical section)."""
+
+    def __init__(self):
+        self._ctl = threading.Lock()
+        self.peer = None
+        self.inbox = queue.Queue()
+
+    def migrate(self, pages):
+        with self._ctl:
+            for p in pages:
+                self.peer.send_frame(p)     # expect: RL003
+
+    def poll(self):
+        with self._ctl:
+            return self.inbox.get()         # expect: RL003
+
+    def nap_locked(self):
+        with self._ctl:
+            time.sleep(0.5)                 # expect: RL003
+
+    def shell_locked(self, cmd):
+        with self._ctl:
+            return subprocess.run(cmd)      # expect: RL003
+
+    def drain(self):
+        with self._ctl:
+            self._pump()                    # expect: RL003
+
+    def _pump(self):
+        # must not flag HERE: no lock is lexically held in this frame —
+        # the caller holding _ctl owns the finding (see drain above)
+        time.sleep(0.05)
+
+    # must not flag: bounded get — backpressure with a timeout is the
+    # sanctioned idiom (the scheduler's pop path does exactly this)
+    def poll_bounded(self):
+        with self._ctl:
+            return self.inbox.get(timeout=0.1)
+
+    # must not flag: the sleep happens after the lock is released
+    def nap_unlocked(self):
+        with self._ctl:
+            n = len(str(self.peer))
+        time.sleep(0.01)
+        return n
+
+
+class DeviceSync:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.buf = None
+
+    def export_locked(self, jax):
+        with self._lock:
+            return jax.device_get(self.buf)     # expect: RL003
+
+    # must not flag: the device sync runs outside the critical section
+    def export_ok(self, jax):
+        with self._lock:
+            buf = self.buf
+        return jax.device_get(buf)

@@ -1,0 +1,57 @@
+"""RL004 true positives + must-not-flag idioms: Condition discipline.
+
+``wait()`` must re-test its predicate in a ``while`` (spurious wakeups
+and stolen wakeups make a plain ``if`` wrong), and both ``wait()`` and
+``notify()`` require the condition's lock (CPython raises RuntimeError;
+the lost-wakeup race is the deeper bug). A ``wait()`` while HOLDING an
+unrelated lock additionally parks that lock for the whole sleep — that
+half reports as RL003.
+"""
+
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._aux = threading.Lock()
+        self.items = []
+
+    # must not flag: the canonical producer/consumer shape
+    def put(self, x):
+        with self._cv:
+            self.items.append(x)
+            self._cv.notify()
+
+    def take_ok(self):
+        with self._cv:
+            while not self.items:
+                self._cv.wait()
+            return self.items.pop(0)
+
+    # must not flag: wait_for re-tests the predicate internally
+    def take_waitfor(self):
+        with self._cv:
+            self._cv.wait_for(lambda: self.items)
+            return self.items.pop(0)
+
+    def take_racy(self):
+        """Regression shape: a stolen wakeup (two consumers, one item)
+        returns from wait() with the predicate false — the `if` version
+        then pops an empty list."""
+        with self._cv:
+            if not self.items:
+                self._cv.wait()             # expect: RL004
+            return self.items.pop(0)
+
+    def poke_unlocked(self):
+        self._cv.notify()                   # expect: RL004
+
+    def wait_unlocked(self):
+        self._cv.wait()                     # expect: RL004
+
+    def wait_holding_aux(self):
+        with self._aux:
+            with self._cv:
+                while not self.items:
+                    self._cv.wait()         # expect: RL003

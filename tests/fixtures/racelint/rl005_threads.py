@@ -1,0 +1,51 @@
+"""RL005 true positives + must-not-flag idioms: thread lifecycle.
+
+A non-daemon thread that is never joined outlives shutdown: the
+interpreter refuses to exit while it runs, and Ctrl-C hangs the
+process. Every long-lived thread in the serve tier is either
+``daemon=True`` (the engine run loop, heartbeats) or joined on the
+shutdown path (worker drains) — anything else is a leak.
+"""
+
+import threading
+
+
+def work():
+    pass
+
+
+def spawn_leaky():
+    """Regression shape: an early flight-recorder draft started its
+    writer thread without daemon=True and without a join on close() —
+    every test process hung at exit until it was killed."""
+    leaked = threading.Thread(target=work)          # expect: RL005
+    leaked.start()
+    return leaked
+
+
+def spawn_timer_leaky():
+    ticker = threading.Timer(5.0, work)             # expect: RL005
+    ticker.start()
+    return ticker
+
+
+# must not flag: daemon at construction — dies with the process
+def spawn_daemon():
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    return t
+
+
+# must not flag: joined in the same module (the shutdown-path idiom)
+def spawn_joined():
+    worker = threading.Thread(target=work)
+    worker.start()
+    worker.join()
+
+
+# must not flag: daemonized by attribute assignment before start
+def spawn_daemoned_later():
+    bg = threading.Thread(target=work)
+    bg.daemon = True
+    bg.start()
+    return bg

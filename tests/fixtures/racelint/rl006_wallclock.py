@@ -1,0 +1,41 @@
+"""RL006 true positives + must-not-flag idioms: time discipline.
+
+``time.time()`` is the WALL clock: NTP slew and DST steps move it, so
+deadline/duration arithmetic built on it misfires — a timeout can
+expire instantly or never. Deadline math belongs on
+``time.monotonic()`` (or ``perf_counter``); ``time.time()`` stays
+legal as a plain timestamp (log records, wire metadata).
+"""
+
+import time
+
+
+def deadline_bad(timeout):
+    """Regression shape: the gateway's first hedge-timer draft armed
+    hedges off the wall clock — an NTP step-back during a deploy made
+    every in-flight request hedge at once."""
+    deadline = time.time() + timeout        # expect: RL006
+    while time.time() < deadline:           # expect: RL006
+        pass
+
+
+def age_bad(start_wall):
+    return time.time() - start_wall         # expect: RL006
+
+
+# must not flag: a bare timestamp (no arithmetic) is what the wall
+# clock is for
+def stamp_ok():
+    return {"ts": time.time()}
+
+
+# must not flag: deadline math on the monotonic clock is the fix
+def deadline_ok(timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        pass
+
+
+# must not flag: perf_counter durations are monotonic too
+def duration_ok(t0):
+    return time.perf_counter() - t0

@@ -1,0 +1,17 @@
+"""Deliberately dirty — DO NOT FIX. The CI static-analysis job lints
+this file expecting a nonzero exit: it is the liveness canary proving
+the racelint gate can still fail. 'Fixing' these lines would turn the
+gate into a rubber stamp.
+"""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def seeded(timeout):
+    deadline = time.time() + timeout    # RL006: wall-clock deadline
+    with _lock:
+        time.sleep(timeout)             # RL003: sleep under the lock
+    return deadline

@@ -1,0 +1,38 @@
+"""Every waiver form racelint honors, each silencing a real finding:
+trailing comment, standalone line above, slug instead of id, comma
+list, and ``all``. The paired test asserts this file lints CLEAN — a
+parser regression that drops any form turns a waiver back into a
+finding and fails it.
+"""
+
+import threading
+import time
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def guarded(self):
+        with self._lock:
+            self.n += 1
+
+    def trailing(self):
+        self.n = 0  # racelint: disable=RL001 — snapshot reset, single-threaded by contract
+
+    def line_above(self):
+        # racelint: disable=lock-guard — slug form: bench teardown, no peers
+        self.n = 5
+
+    def comma_list(self, timeout):
+        self.n = int(time.time() + timeout)  # racelint: disable=RL001,RL006 — epoch bucket id, not a deadline
+
+    def all_form(self):
+        self.n = 7  # racelint: disable=all — kitchen-sink waiver
+
+
+def sleepy(box: Box):
+    with box._lock:
+        # racelint: disable=RL003 — 10ms settling nap in a test-only path
+        time.sleep(0.01)
