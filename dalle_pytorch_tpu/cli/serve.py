@@ -99,6 +99,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "weight); per-token KV read traffic drops by "
                         "the visibility ratio (docs/SERVING.md 'Sparse "
                         "decode reads')")
+    p.add_argument("--speculative", type=int, default=0,
+                   help="speculative decode: draft-and-verify with k "
+                        "tokens per round (0 = off). A shallow draft "
+                        "head — the first --draft_layers transformer "
+                        "layers plus the same logit head, no extra "
+                        "weights — proposes k-1 tokens, ONE k-wide "
+                        "full-model pass verifies all of them, and the "
+                        "longest matching prefix is accepted. "
+                        "Deterministic per-position sampling makes the "
+                        "emitted stream byte-identical to eager decode "
+                        "at every acceptance rate; only latency "
+                        "changes (docs/SERVING.md 'Speculative "
+                        "decode'). Composes with --kv dense/paged and "
+                        "--paged_attn, not with --sparse_reads")
+    p.add_argument("--draft_layers", type=int, default=0,
+                   help="draft depth d for --speculative (0 = depth/2): "
+                        "more layers -> higher acceptance, costlier "
+                        "drafts; the sweet spot is where d/depth * k "
+                        "extra draft FLOPs still undercut the "
+                        "sequential full-depth steps the accepted "
+                        "tokens skip")
     p.add_argument("--prefix_cache", action="store_true",
                    help="cross-request prefix cache (requires --kv "
                         "paged): prompt KV pages become refcounted, "
@@ -428,6 +449,7 @@ def main(argv=None):
         quantize_cache=args.quantize == "int8_kv",
         kv=args.kv, page_size=args.page_size, num_pages=args.num_pages,
         paged_attn=args.paged_attn, sparse_reads=args.sparse_reads,
+        speculative=args.speculative, draft_layers=args.draft_layers,
         prefix_cache=args.prefix_cache,
         default_cfg_scale=args.cfg_scale,
         replicas=args.replicas, mesh_devices=args.mesh_devices,
@@ -500,6 +522,9 @@ def main(argv=None):
         else f"{args.kv}/{args.paged_attn}" \
         + ("/sparse_reads" if args.sparse_reads else "") \
         + ("/prefix_cache" if args.prefix_cache else "")
+    if args.speculative:
+        kv_desc += (f", speculative k={args.speculative}"
+                    f"/d={args.draft_layers or 'depth/2'}")
     if args.cfg_scale > 0:
         kv_desc += f", cfg_scale={args.cfg_scale:g}"
     iso_desc = args.isolation if args.transport == "pipe" \

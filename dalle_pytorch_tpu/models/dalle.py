@@ -244,6 +244,30 @@ def to_logits(params: dict, h: Array) -> Array:
     return core.linear(params["to_logits"]["proj"], h)
 
 
+def draft_transformer_config(tcfg: T.TransformerConfig,
+                             d: int) -> T.TransformerConfig:
+    """The shallow draft model's config for speculative decode: the
+    first ``d`` layers of the target transformer, everything else
+    unchanged. ``sparse_attn`` must be re-sliced explicitly because
+    ``sparse_pattern`` is derived from depth — a bare depth override
+    would re-broadcast a bool or fail the tuple-length assert."""
+    if not 1 <= d <= tcfg.depth:
+        raise ValueError(
+            f"draft depth must be in [1, {tcfg.depth}], got {d}")
+    return dataclasses.replace(
+        tcfg, depth=d, sparse_attn=tuple(tcfg.sparse_pattern[:d]))
+
+
+def draft_transformer_params(params: dict, d: int) -> dict:
+    """The draft head's weights: the leading-``d`` slice of every
+    stacked transformer leaf. An early exit, not a separate model — the
+    draft shares the target's weights (and, at the call site, the SAME
+    ``to_logits`` head and sampler), so no extra memory and no training.
+    Cheap under jit (a slice of resident buffers, no copy); call it
+    INSIDE the traced decode fn so hot-swapped weights stay live."""
+    return jax.tree.map(lambda a: a[:d], params)
+
+
 def quantize_for_decode(params: dict) -> dict:
     """Int8-quantize the weight-heavy inference path — the transformer
     linears and the vocab head (ops.quant docstring has the bandwidth

@@ -871,3 +871,472 @@ def decode_loop_paged(params: dict, cur_tok: Array, pos: Array,
     (cur_tok, pos, active, pool), emits = lax.scan(
         one_step, (cur_tok, pos, active, pool), None, length=steps)
     return cur_tok, pos, active, pool, jnp.moveaxis(emits, 0, 1)
+
+
+# ---------------------------------------------------------------------------
+# speculative decode: draft-and-verify inside the fused serving loop
+# ---------------------------------------------------------------------------
+#
+# The single biggest latency lever left after the fused-K loop: sequential
+# image-token steps are latency-bound on the FULL stack's depth, but most
+# tokens are cheap to predict. Draft-and-verify runs a SHALLOW draft (the
+# first d transformer layers + the same logit head — an early exit, no
+# extra weights) to propose k tokens, then ONE k-wide pass through the
+# full model verifies all of them at once. Because sampling here is a
+# DETERMINISTIC function of (logits, fold_in(rng, position)) — see
+# models.dalle.sample_per_slot — the verify pass computes exactly the
+# token the eager loop would have emitted at every offset: accept the
+# longest prefix where the draft matched, take the verify sample at the
+# first mismatch as the (always-correct) continuation, and the emitted
+# stream is BYTE-IDENTICAL to eager generate_images by construction —
+# not distributionally equivalent, identical. Rejection costs nothing
+# but the wasted draft work: the cache rows written past the accepted
+# prefix are stale-by-invariant (reads only ever touch rows < the
+# chunk-start pos, and the next round rewrites them before pos crosses),
+# so pos never rewinds and no KV pages are ever unmapped on a rejection.
+#
+# The wide verify is structurally a K-wide decode chunk: the same
+# layernorm/qkv/read/store seams as ``_decode_step_math``, with W query
+# rows per slot instead of one. Query i (position pos+i) attends the
+# CACHED prefix (rows j < pos — rows >= pos are stale and never read)
+# plus the chunk's own fresh K/V rows 0..i (triangular intra mask, self
+# always attended — the narrow path's concatenated self-logit,
+# generalized). W = 1 reduces to the narrow math exactly, so k=1
+# speculation IS the eager loop.
+
+
+def _gather_read_wide(q: Array, k: Array, v: Array, ck: Array, cv: Array,
+                      allowed_cached: Array, allowed_intra: Array, *,
+                      scale: float, ksc: Optional[Array] = None,
+                      vsc: Optional[Array] = None) -> Array:
+    """W-wide twin of ``_gather_read``: q/k/v (b, h, W, dh) fresh chunk
+    rows, ck/cv (b, h, L, dh) cached rows, allowed_cached (b, W, L) the
+    per-query cached-row mask, allowed_intra (b, W, W) the intra-chunk
+    mask (triangular, diagonal True — self is always attended, exactly
+    the narrow path's unmasked self-logit). One softmax over the
+    concatenated [cached, intra] logits per query; int8 scales applied
+    outside the contractions in score dtype, the narrow path's
+    contract. Returns (b, h, W, dh)."""
+    quantized = ksc is not None
+    ckc = ck.astype(q.dtype) if quantized else ck
+    scores = jnp.einsum("bhqd,bhjd->bhqj", q, ckc) * scale
+    if quantized:
+        scores = scores * ksc[:, :, None, :].astype(scores.dtype)
+    scores = jnp.where(allowed_cached[:, None], scores,
+                       core.neg_inf(scores.dtype))
+    intra = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    intra = jnp.where(allowed_intra[:, None], intra,
+                      core.neg_inf(intra.dtype))
+    w = jax.nn.softmax(jnp.concatenate([scores, intra], -1), axis=-1)
+    L = ck.shape[2]
+    wj, wi = w[..., :L], w[..., L:]
+    if quantized:
+        wj = wj * vsc[:, :, None, :].astype(wj.dtype)
+        cvc = cv.astype(q.dtype)
+    else:
+        cvc = cv
+    return (jnp.einsum("bhqj,bhjd->bhqd", wj, cvc)
+            + jnp.einsum("bhqk,bhkd->bhqd", wi, v))
+
+
+def _kernel_read_wide(q: Array, k: Array, v: Array, pool_k: Array,
+                      pool_v: Array, block_tables: Array, pos: Array,
+                      allowed_cached: Array, allowed_intra: Array, *,
+                      scale: float, ksc: Optional[Array] = None,
+                      vsc: Optional[Array] = None) -> Array:
+    """W-wide twin of ``_kernel_read``: one Pallas ragged-paged-attention
+    call per offset (a static python loop — W is a small compile-time
+    constant), each walking the cached pages up to the CHUNK-START
+    ``pos`` with that offset's row mask, then a generalized two-estimate
+    merge folds in the offset's intra-chunk logits (keys 0..i, self
+    included). W = 1 with an all-True 1x1 intra mask is exactly the
+    narrow merge."""
+    from dalle_pytorch_tpu.ops import paged_attention as PA
+    W = q.shape[2]
+    outs = []
+    for i in range(W):
+        acc, m, l = PA.paged_decode_attention(
+            q[:, :, i, :], pool_k, pool_v, block_tables, pos,
+            allowed_cached[:, i, :], scale=scale, k_scales=ksc,
+            v_scales=vsc)
+        s = (jnp.einsum("bhd,bhkd->bhk", q[:, :, i, :],
+                        k[:, :, :i + 1, :]).astype(jnp.float32) * scale)
+        s = jnp.where(allowed_intra[:, None, i, :i + 1], s,
+                      core.neg_inf(jnp.float32))
+        m2 = jnp.max(s, axis=-1)               # self is finite: m2 too
+        m_t = jnp.maximum(m, m2)
+        alpha = jnp.exp(m - m_t)
+        wk = jnp.exp(s - m_t[..., None])
+        denom = l * alpha + jnp.sum(wk, axis=-1)
+        out = (acc * alpha[..., None]
+               + jnp.einsum("bhk,bhkd->bhd", wk,
+                            v[:, :, :i + 1, :].astype(jnp.float32))) \
+            / denom[..., None]
+        outs.append(out.astype(q.dtype))
+    return jnp.stack(outs, axis=2)
+
+
+def _decode_chunk_math(params: dict, x_toks: Array, pos: Array,
+                       cache: dict, *, cfg, key_mask: Array,
+                       attn_impl: str = "gather",
+                       block_tables: Optional[Array] = None,
+                       out_sync=None) -> Tuple[Array, Array, Array]:
+    """W-wide generalization of ``_decode_step_math`` — the speculative
+    verify (and draft) program's core. x_toks (b, W, dim) are the
+    embeddings of the tokens at positions pos..pos+W-1 (pos (b,) the
+    per-slot chunk start); the cache holds valid rows STRICTLY below
+    ``pos`` only (rows at/past pos are stale and never read — the
+    chunk's own K/V is carried fresh through the triangular intra mask
+    instead). Returns (h_out (b, W, dim), ks, vs (depth, b, heads, W,
+    dh)) — the caller owns the write-back, same split as the narrow
+    math. ``attn_impl='kernel'`` reads ``cache`` as the raw page pool
+    through ``block_tables`` (one kernel walk per offset); ``'gather'``
+    reads it as a dense per-slot view (the dense slot cache or
+    ``paged_view``). Sparse layers mask by the layout row of each
+    query's own position, intra keys included; the chunk-local self is
+    always attended (the narrow path's self-logit contract)."""
+    from dalle_pytorch_tpu.ops import transformer as T
+    b, W, _ = x_toks.shape
+    total_len = key_mask.shape[1]
+    sparse_flags = jnp.asarray(cfg.sparse_pattern)
+    any_sparse = any(cfg.sparse_pattern)
+    if attn_impl not in ("gather", "kernel"):
+        raise ValueError(f"attn_impl must be 'gather' or 'kernel', "
+                         f"got {attn_impl!r}")
+    kernel_mode = attn_impl == "kernel"
+    if kernel_mode and block_tables is None:
+        raise ValueError("attn_impl='kernel' requires block_tables")
+    if getattr(pos, "ndim", 0) != 1:
+        raise ValueError("the wide chunk math requires per-slot (b,) "
+                         "positions (the serving decode shape)")
+
+    j = jnp.arange(total_len)
+    offs = jnp.arange(W)
+    # cached rows: strictly before the CHUNK START for every query
+    # (rows in [pos, pos+i) are stale — the fresh intra keys stand in)
+    causal_c = j[None, :] < pos[:, None]                      # (b, L)
+    dense_cached = jnp.broadcast_to(
+        (causal_c & key_mask)[:, None, :], (b, W, total_len))
+    # intra-chunk: key kk visible to query i iff kk <= i (self included)
+    tri = offs[:, None] >= offs[None, :]                      # (W, W)
+    dense_intra = jnp.broadcast_to(tri[None], (b, W, W))
+    if any_sparse:
+        layout = _sparse_layout(cfg, total_len)
+        qrows = jnp.minimum(pos[:, None] + offs[None, :],
+                            total_len - 1)                    # (b, W)
+        lrows = jnp.take(layout, qrows, axis=0)               # (b, W, L)
+        sparse_cached = dense_cached & lrows
+        intra_lay = jnp.take_along_axis(
+            lrows, jnp.broadcast_to(qrows[:, None, :], (b, W, W)),
+            axis=2)                      # (b, W, W): layout[p+i, p+kk]
+        # jaxlint: disable=JL001 — static W identity, trace-time const
+        self_eye = jnp.eye(W, dtype=bool)[None]
+        sparse_intra = dense_intra & (intra_lay | self_eye)
+    else:
+        sparse_cached, sparse_intra = dense_cached, dense_intra
+
+    quantized = "k_scale" in cache
+
+    def attn_cached(lp, h, ck, cv, is_sparse, ksc=None, vsc=None):
+        p = lp["attn"]
+        hn = core.layernorm(p["ln"], h)
+        q, k, v = attn_ops.qkv_project(p, hn, cfg.heads)  # (b, h, W, dh)
+        a_c = jnp.where(is_sparse, sparse_cached, dense_cached) \
+            if any_sparse else dense_cached
+        a_i = jnp.where(is_sparse, sparse_intra, dense_intra) \
+            if any_sparse else dense_intra
+        if kernel_mode:
+            out = _kernel_read_wide(q, k, v, ck, cv, block_tables, pos,
+                                    a_c, a_i, scale=cfg.scale, ksc=ksc,
+                                    vsc=vsc)
+        else:
+            out = _gather_read_wide(q, k, v, ck, cv, a_c, a_i,
+                                    scale=cfg.scale, ksc=ksc, vsc=vsc)
+        if out_sync is not None:
+            # the mesh seam, unchanged: gather heads before the out
+            # projection instead of letting GSPMD partial-sum it
+            out = out_sync(out)
+        return attn_ops.output_tail(p, out), k, v
+
+    def body(carry, xs):
+        if quantized:
+            lp, ck, cv, ksc, vsc, is_sparse = xs
+        else:
+            lp, ck, cv, is_sparse = xs
+            ksc = vsc = None
+        if cfg.reversible:
+            x1, x2 = carry
+            a, k, v = attn_cached(lp, x2, ck, cv, is_sparse, ksc, vsc)
+            y1 = x1 + a
+            y2 = x2 + T.ff_or_moe(lp, y1, cfg, None, False)[0]
+            return (y1, y2), (k, v)
+        h = carry
+        a, k, v = attn_cached(lp, h, ck, cv, is_sparse, ksc, vsc)
+        h = h + a
+        h = h + T.ff_or_moe(lp, h, cfg, None, False)[0]
+        return h, (k, v)
+
+    carry0 = (x_toks, x_toks) if cfg.reversible else x_toks
+    xs = (params, cache["k"], cache["v"], cache["k_scale"],
+          cache["v_scale"], sparse_flags) if quantized else \
+        (params, cache["k"], cache["v"], sparse_flags)
+    carry, (ks, vs) = lax.scan(body, carry0, xs)
+    h_out = (carry[0] + carry[1]) * 0.5 if cfg.reversible else carry
+    return h_out, ks, vs
+
+
+def _store_rows_wide(cache: dict, ks: Array, vs: Array,
+                     pos: Array) -> dict:
+    """W-wide twin of ``_store_rows_per_slot``: ks/vs (depth, b, heads,
+    W, dh), slot b's row i lands at cache row pos[b]+i. Rows past the
+    cache end are DROPPED (``mode='drop'``) — the chunk near the
+    sequence end writes only its in-range rows, and a parked dead slot
+    rewrites rows 0..W-1, which admission's prefill and the first
+    verify chunk always overwrite before any read (the stale-rows
+    invariant). Same quantization contract as every other writer."""
+    b = pos.shape[0]
+    W = ks.shape[3]
+    bidx = jnp.arange(b)[:, None]                             # (b, 1)
+    rows = pos[:, None] + jnp.arange(W)[None, :]              # (b, W)
+
+    def put_rows(buf, r):
+        # buf (depth, b, heads, L, dh); advanced indices at dims 1 and 3
+        # are non-adjacent, so the update value is (b, W, depth, heads,
+        # dh)
+        return buf.at[:, bidx, :, rows, :].set(
+            jnp.transpose(r, (1, 3, 0, 2, 4)), mode="drop")
+
+    def put_scales(buf, sc):
+        # buf (depth, b, heads, L); value (b, W, depth, heads)
+        return buf.at[:, bidx, :, rows].set(
+            jnp.transpose(sc, (1, 3, 0, 2)), mode="drop")
+
+    if "k_scale" in cache:
+        kq, ksc = _quantize_rows(ks)
+        vq, vsc = _quantize_rows(vs)
+        return {"k": put_rows(cache["k"], kq),
+                "v": put_rows(cache["v"], vq),
+                "k_scale": put_scales(cache["k_scale"], ksc),
+                "v_scale": put_scales(cache["v_scale"], vsc)}
+    return {"k": put_rows(cache["k"], ks), "v": put_rows(cache["v"], vs)}
+
+
+def _store_rows_paged_wide(pool: dict, ks: Array, vs: Array, pos: Array,
+                           block_tables: Array, active: Array,
+                           total_len: int) -> dict:
+    """W-wide twin of ``_store_rows_paged``: slot b's row i lands in
+    physical page ``block_tables[b, (pos[b]+i) // ps]`` at offset
+    ``(pos[b]+i) % ps``. Rows past ``total_len`` and every row of an
+    inactive slot are redirected to the reserved trash page 0 — a dead
+    slot's block-table entries may map pages the allocator already
+    handed to a newer request, the same hazard the narrow writer
+    guards. The engine's ``_map_ahead`` maps the FULL speculative
+    horizon before dispatch, so every in-range row finds its page
+    mapped."""
+    ps = pool["k"].shape[3]
+    b = pos.shape[0]
+    W = ks.shape[3]
+    bidx = jnp.arange(b)[:, None]                             # (b, 1)
+    rows = pos[:, None] + jnp.arange(W)[None, :]              # (b, W)
+    valid = active[:, None] & (rows < total_len)
+    safe = jnp.minimum(rows, total_len - 1)
+    page = jnp.where(valid, block_tables[bidx, safe // ps], 0)
+    off = jnp.where(valid, safe % ps, 0)
+
+    def put_rows(buf, r):
+        # buf (depth, P, heads, ps, dh); value (b, W, depth, heads, dh)
+        return buf.at[:, page, :, off, :].set(
+            jnp.transpose(r, (1, 3, 0, 2, 4)))
+
+    def put_scales(buf, sc):
+        # buf (depth, P, heads, ps); value (b, W, depth, heads)
+        return buf.at[:, page, :, off].set(
+            jnp.transpose(sc, (1, 3, 0, 2)))
+
+    if "k_scale" in pool:
+        kq, ksc = _quantize_rows(ks)
+        vq, vsc = _quantize_rows(vs)
+        return {"k": put_rows(pool["k"], kq),
+                "v": put_rows(pool["v"], vq),
+                "k_scale": put_scales(pool["k_scale"], ksc),
+                "v_scale": put_scales(pool["v_scale"], vsc)}
+    return {"k": put_rows(pool["k"], ks), "v": put_rows(pool["v"], vs)}
+
+
+def speculative_draft(draft_params: dict, cur_tok: Array, pos: Array,
+                      read_cache: dict, *, cfg, key_mask: Array, k: int,
+                      embed_fn, sample_fn, attn_impl: str = "gather",
+                      block_tables: Optional[Array] = None,
+                      out_sync=None) -> Array:
+    """Propose k-1 draft tokens with the SHALLOW early-exit head:
+    ``draft_params`` is the first-d-layers slice of the stacked
+    transformer params and ``cfg`` its depth-d config
+    (``models.dalle.draft_transformer_config``), run through the same
+    logit head and the SAME per-slot sampler — so with d == depth the
+    draft IS the target model and every proposal verifies (the
+    acceptance-test lever). Stash-free: draft step t recomputes the
+    t-wide chunk math over the tokens so far (no cache write, ~d·k²/2
+    rows — cheap for the small k this targets). Returns (b, k-1) int32
+    (an empty (b, 0) when k == 1: no draft runs, speculation degrades
+    to the eager step exactly)."""
+    toks = [cur_tok]
+    for t in range(1, k):
+        xs = jnp.stack([embed_fn(tok, pos + i)
+                        for i, tok in enumerate(toks)], axis=1)
+        h, _, _ = _decode_chunk_math(
+            draft_params, xs, pos, read_cache, cfg=cfg,
+            key_mask=key_mask, attn_impl=attn_impl,
+            block_tables=block_tables, out_sync=out_sync)
+        toks.append(sample_fn(h[:, -1, :], pos + t))
+    if k == 1:
+        return jnp.zeros((cur_tok.shape[0], 0), jnp.int32)
+    return jnp.stack(toks[1:], axis=1)
+
+
+def speculative_verify(params: dict, cur_tok: Array, drafts: Array,
+                       pos: Array, act: Array, read_cache: dict, *, cfg,
+                       key_mask: Array, total_len: int, embed_fn,
+                       sample_fn, attn_impl: str = "gather",
+                       block_tables: Optional[Array] = None,
+                       out_sync=None):
+    """ONE full-model pass over [cur_tok, drafts] (k tokens wide),
+    accept the longest matching prefix. Per offset i the verify sample
+    ``s_i = sample_fn(h_i, pos+i+1)`` is EXACTLY the token the eager
+    loop would emit at that position (deterministic fold_in(rng, pos)
+    sampling), so acceptance is equality — not a stochastic test — and
+    the first rejected offset's verify sample is itself the correct
+    continuation (the "free" token: even total rejection advances one
+    position, like eager). The accepted length is clamped at the
+    sequence end so the emitted window never crosses ``total_len``.
+
+    Returns ``(emit (b, k), cur_new, pos_new, act_new, ks, vs)``:
+    emit[i] holds the token at position pos+i or the -1 harvest
+    sentinel; ks/vs are ALL k fresh K/V rows (depth, b, heads, k, dh)
+    for the caller's write-back — rows past the accepted prefix are
+    stale-by-invariant, overwritten by the next round before the
+    chunk-start pos ever crosses them, so rejection needs no rewind
+    and no page unmapping."""
+    b = cur_tok.shape[0]
+    k = drafts.shape[1] + 1
+    toks = [cur_tok] + [drafts[:, t] for t in range(k - 1)]
+    xv = jnp.stack([embed_fn(tok, pos + i)
+                    for i, tok in enumerate(toks)], axis=1)
+    h, ks, vs = _decode_chunk_math(
+        params, xv, pos, read_cache, cfg=cfg, key_mask=key_mask,
+        attn_impl=attn_impl, block_tables=block_tables,
+        out_sync=out_sync)
+    s = jnp.stack([sample_fn(h[:, i, :], pos + i + 1)
+                   for i in range(k)], axis=1)                # (b, k)
+    if k > 1:
+        match = (s[:, :k - 1] == drafts).astype(jnp.int32)
+        jm = jnp.sum(jnp.cumprod(match, axis=1), axis=1)      # [0, k-1]
+    else:
+        jm = jnp.zeros_like(pos)
+    # accepted END offset: positions pos..pos+e emit (e+1 tokens),
+    # clamped so the last emitted position stays < total_len (an active
+    # slot always has pos <= total_len-1, so e >= 0)
+    e = jnp.minimum(jm, total_len - 1 - pos)
+    offs = jnp.arange(k)
+    emit_vals = jnp.concatenate([cur_tok[:, None], s[:, :k - 1]],
+                                axis=1)
+    emit = jnp.where(act[:, None] & (offs[None, :] <= e[:, None]),
+                     emit_vals, -1)
+    cur_new = jnp.take_along_axis(s, e[:, None], axis=1)[:, 0]
+    pos_new = pos + e + 1
+    act_new = act & (pos_new < total_len)
+    # dead slots park at (tok 0, pos 0), the eager loop's contract
+    cur_new = jnp.where(act_new, cur_new, 0)
+    pos_new = jnp.where(act_new, pos_new, 0)
+    return emit, cur_new, pos_new, act_new, ks, vs
+
+
+def _draft_cache_view(read_cache: dict, depth: int) -> dict:
+    """The draft's read view: the first ``depth`` layers of the full
+    cache/view/pool (every KV layout carries depth on the leading
+    axis, int8 scales included)."""
+    return {key: buf[:depth] for key, buf in read_cache.items()}
+
+
+def decode_loop_spec(params: dict, draft_params: dict, cur_tok: Array,
+                     pos: Array, active: Array, cache: dict, *, cfg,
+                     draft_cfg, key_mask: Array, steps: int, k: int,
+                     embed_fn, sample_fn, out_sync=None
+                     ) -> Tuple[Array, Array, Array, dict, Array]:
+    """``decode_loop`` with draft-and-verify speculation: each of the
+    ``steps`` scanned rounds drafts k-1 tokens through the shallow head,
+    verifies all k in ONE full-model k-wide pass, and emits the accepted
+    prefix — between 1 and k tokens per round, every one byte-identical
+    to the eager loop's. Same one-compile fused-program regime; the emit
+    ring widens to (b, steps*k) with the -1 sentinel filling rejected
+    offsets and finished slots, which the harvest's ``row[row >= 0]``
+    already handles (delivered tokens only — rejected drafts never
+    reach the host accounting)."""
+    total_len = cache["k"].shape[3]
+
+    def one_round(carry, _):
+        cur_tok, pos, act, cache = carry
+        drafts = speculative_draft(
+            draft_params, cur_tok, pos,
+            _draft_cache_view(cache, draft_cfg.depth), cfg=draft_cfg,
+            key_mask=key_mask, k=k, embed_fn=embed_fn,
+            sample_fn=sample_fn, out_sync=out_sync)
+        emit, cur_tok, pos_new, act, ks, vs = speculative_verify(
+            params, cur_tok, drafts, pos, act, cache, cfg=cfg,
+            key_mask=key_mask, total_len=total_len, embed_fn=embed_fn,
+            sample_fn=sample_fn, out_sync=out_sync)
+        cache = _store_rows_wide(cache, ks, vs, pos)
+        return (cur_tok, pos_new, act, cache), emit
+
+    (cur_tok, pos, active, cache), emits = lax.scan(
+        one_round, (cur_tok, pos, active, cache), None, length=steps)
+    ring = jnp.moveaxis(emits, 0, 1).reshape(cur_tok.shape[0],
+                                             steps * k)
+    return cur_tok, pos, active, cache, ring
+
+
+def decode_loop_spec_paged(params: dict, draft_params: dict,
+                           cur_tok: Array, pos: Array, active: Array,
+                           pool: dict, block_tables: Array, *, cfg,
+                           draft_cfg, key_mask: Array, total_len: int,
+                           steps: int, k: int, embed_fn, sample_fn,
+                           attn_impl: str = "gather", out_sync=None
+                           ) -> Tuple[Array, Array, Array, dict, Array]:
+    """``decode_loop_paged`` with draft-and-verify speculation: the
+    paged twin of ``decode_loop_spec`` — the k-wide verify rides the
+    block tables exactly like the narrow step (the dense-view gather
+    oracle, or one in-place Pallas kernel walk per offset under
+    ``attn_impl='kernel'``), and all k fresh rows scatter back through
+    ``_store_rows_paged_wide`` (inactive/overflow rows to the trash
+    page). The host maps the FULL speculative horizon (steps*k rows)
+    before dispatch, and rejection never unmaps anything — pos only
+    advances, so the no-alloc-churn contract holds per round, not just
+    per chunk. ``sparse_reads`` does not compose (rejected at engine
+    construction): the wide verify has no trimmed-visibility wide read."""
+    kernel = attn_impl == "kernel"
+
+    def one_round(carry, _):
+        cur_tok, pos, act, pool = carry
+        read = pool if kernel else paged_view(pool, block_tables,
+                                              total_len)
+        bt = block_tables if kernel else None
+        impl = "kernel" if kernel else "gather"
+        drafts = speculative_draft(
+            draft_params, cur_tok, pos,
+            _draft_cache_view(read, draft_cfg.depth), cfg=draft_cfg,
+            key_mask=key_mask, k=k, embed_fn=embed_fn,
+            sample_fn=sample_fn, attn_impl=impl, block_tables=bt,
+            out_sync=out_sync)
+        emit, cur_tok, pos_new, act, ks, vs = speculative_verify(
+            params, cur_tok, drafts, pos, act, read, cfg=cfg,
+            key_mask=key_mask, total_len=total_len, embed_fn=embed_fn,
+            sample_fn=sample_fn, attn_impl=impl, block_tables=bt,
+            out_sync=out_sync)
+        pool = _store_rows_paged_wide(pool, ks, vs, pos, block_tables,
+                                      act, total_len)
+        return (cur_tok, pos_new, act, pool), emit
+
+    (cur_tok, pos, active, pool), emits = lax.scan(
+        one_round, (cur_tok, pos, active, pool), None, length=steps)
+    ring = jnp.moveaxis(emits, 0, 1).reshape(cur_tok.shape[0],
+                                             steps * k)
+    return cur_tok, pos, active, pool, ring

@@ -282,6 +282,8 @@ class Engine:
                  num_pages: int = 0,
                  paged_attn: str = "gather",
                  sparse_reads: bool = False,
+                 speculative: int = 0,
+                 draft_layers: int = 0,
                  prefix_cache: bool = False,
                  prefix_entries: int = 256,
                  model_version: str = "0",
@@ -293,6 +295,7 @@ class Engine:
         import jax
         import jax.numpy as jnp
 
+        from dalle_pytorch_tpu.models import dalle as D
         from dalle_pytorch_tpu.obs import flight as oflight
         from dalle_pytorch_tpu.ops import decode as decode_ops
 
@@ -372,6 +375,37 @@ class Engine:
                     f"so the per-layer read shapes resolve statically "
                     f"in the fused decode program; pattern {pattern} "
                     f"has period {period}")
+        # speculative decode (docs/SERVING.md "Speculative decode"):
+        # each fused round drafts k-1 tokens with a shallow early-exit
+        # head (the first draft_layers transformer layers + the same
+        # logit head — no extra weights) and verifies all k in ONE
+        # k-wide full-model pass. Deterministic fold_in(rng, pos)
+        # sampling makes acceptance an equality test, so the emitted
+        # stream is byte-identical to eager — speculation only changes
+        # how many sequential full-depth passes each token costs.
+        self.speculative = int(speculative)
+        if self.speculative < 0:
+            raise ValueError(
+                f"speculative must be >= 0, got {speculative}")
+        depth = cfg.transformer.depth
+        self.draft_layers = int(draft_layers) or max(depth // 2, 1)
+        self._draft_cfg = None
+        if self.speculative:
+            if self.sparse_reads:
+                raise ValueError(
+                    "speculative does not compose with sparse_reads — "
+                    "the k-wide verify reads the full cached prefix "
+                    "per query (masked, not trimmed); run one or the "
+                    "other")
+            if not 1 <= self.draft_layers <= depth:
+                raise ValueError(
+                    f"draft_layers must be in [1, depth={depth}], "
+                    f"got {self.draft_layers}")
+            self._draft_cfg = D.draft_transformer_config(
+                cfg.transformer, self.draft_layers)
+        # the per-dispatch device-pos advance: chunk_steps fused rounds,
+        # each emitting up to k tokens (1 when not speculating)
+        self._chunk_span = self.chunk_steps * max(self.speculative, 1)
 
         if prefill_buckets is None:
             buckets = S.prefill_buckets(cfg.text_seq_len)
@@ -555,6 +589,18 @@ class Engine:
         self.completed = 0
         self.expired = 0
         self.occupancy_sum = 0
+        # speculative accounting: DELIVERED tokens over rounds that
+        # emitted anything — tokens_decoded/occupancy already count only
+        # ring entries >= 0, so rejected drafts never inflate them; these
+        # two add the acceptance-rate numerator/denominator
+        self.spec_rounds = 0            # verify rounds that delivered
+        self.spec_delivered = 0         # tokens those rounds delivered
+        self.spec_proposed = 0          # positions those rounds COULD
+        #                                 have delivered: k, clamped to
+        #                                 the sequence end — so a
+        #                                 perfect draft scores exactly
+        #                                 1.0, not "1.0 minus the last
+        #                                 round's truncation"
         self._t_start = None
         self._last_log = 0
 
@@ -728,10 +774,24 @@ class Engine:
         counter below proves it; the guidance-pair state rides as three
         more (num_slots,) arrays, never a new trace."""
         self.decode_traces += 1
+        from dalle_pytorch_tpu.models import dalle as D
         from dalle_pytorch_tpu.ops import decode as decode_ops
 
         embed_fn, sample_fn = self._cfg_closures(
             params, keys, temp, topk_k, top_p, partner, cfgs, uncond)
+        if self.speculative:
+            # the draft weights are a leading-layers slice of the SAME
+            # resident params, taken inside the traced fn so hot-swap,
+            # donation and mesh placement all flow through unchanged
+            draft_p = D.draft_transformer_params(
+                params["transformer"], self.draft_layers)
+            return decode_ops.decode_loop_spec(
+                params["transformer"], draft_p, cur_tok, pos, active,
+                cache, cfg=self.cfg.transformer,
+                draft_cfg=self._draft_cfg, key_mask=self.key_mask,
+                steps=self.chunk_steps, k=self.speculative,
+                embed_fn=embed_fn, sample_fn=sample_fn,
+                out_sync=self._decode_out_sync())
         return decode_ops.decode_loop(
             params["transformer"], cur_tok, pos, active, cache,
             cfg=self.cfg.transformer, key_mask=self.key_mask,
@@ -750,10 +810,22 @@ class Engine:
         per-chunk constant — the host maps every page the chunk could
         write before dispatch — so this too traces exactly once."""
         self.decode_traces += 1
+        from dalle_pytorch_tpu.models import dalle as D
         from dalle_pytorch_tpu.ops import decode as decode_ops
 
         embed_fn, sample_fn = self._cfg_closures(
             params, keys, temp, topk_k, top_p, partner, cfgs, uncond)
+        if self.speculative:
+            draft_p = D.draft_transformer_params(
+                params["transformer"], self.draft_layers)
+            return decode_ops.decode_loop_spec_paged(
+                params["transformer"], draft_p, cur_tok, pos, active,
+                cache, block_tables, cfg=self.cfg.transformer,
+                draft_cfg=self._draft_cfg, key_mask=self.key_mask,
+                total_len=self.total_len, steps=self.chunk_steps,
+                k=self.speculative, embed_fn=embed_fn,
+                sample_fn=sample_fn, attn_impl=self.paged_attn,
+                out_sync=self._decode_out_sync())
         return decode_ops.decode_loop_paged(
             params["transformer"], cur_tok, pos, active, cache,
             block_tables, cfg=self.cfg.transformer,
@@ -1681,7 +1753,11 @@ class Engine:
         from dalle_pytorch_tpu.serve import kv_pool as KV
         for i in range(self.num_slots):
             while self.slots[i] is not None:
-                target = min(self._pos_est[i] + self.chunk_steps,
+                # _chunk_span covers the SPECULATIVE horizon: a chunk
+                # can write up to chunk_steps*k rows, and every one must
+                # find its page mapped (rejected offsets write too —
+                # their rows are stale-by-invariant, not unmapped)
+                target = min(self._pos_est[i] + self._chunk_span,
                              self.total_len)
                 short = KV.pages_for(target, self.page_size) \
                     - len(self._slot_pages[i])
@@ -1785,7 +1861,7 @@ class Engine:
                   if s is not None]
         if self.kv == "paged":
             for i, _ in owners:
-                self._pos_est[i] = min(self._pos_est[i] + self.chunk_steps,
+                self._pos_est[i] = min(self._pos_est[i] + self._chunk_span,
                                        self.total_len)
         self._pending.append(_Chunk(ring, self.active, owners))
         self.decode_steps += self.chunk_steps
@@ -1837,6 +1913,44 @@ class Engine:
             toks = row[row >= 0]
             slot.emitted.extend(int(t) for t in toks)
             emitted += len(toks)
+            if self.speculative:
+                # acceptance accounting over DELIVERED tokens only: a
+                # round's k-wide ring window holds its accepted prefix,
+                # -1 past it — rejected drafts never reach the host, so
+                # tokens_decoded/occupancy stay exact for free. The
+                # denominator is each round's true potential (k,
+                # clamped to the sequence end — pos before the round is
+                # recoverable by walking the windows cumulatively), so
+                # a full-depth draft scores exactly 1.0
+                kk = self.speculative
+                pos_cursor = slot.t0 + len(slot.emitted) - len(toks)
+                for w in ring[i].reshape(-1, kk):
+                    n = int((w >= 0).sum())
+                    if n == 0:
+                        continue
+                    self.spec_rounds += 1
+                    self.spec_proposed += min(
+                        kk, self.total_len - pos_cursor)
+                    pos_cursor += n
+                self.spec_delivered += len(toks)
+            if self.kv == "paged" and self.speculative:
+                # tighten the host's pos bound with the truth the ring
+                # just delivered: the dispatch-time advance assumed full
+                # acceptance (k per round), so under low acceptance the
+                # estimate (and page map-ahead) would creep ahead of the
+                # device; pos == t0 + len(emitted) is exact, plus one
+                # full span per chunk still in flight
+                later = sum(1 for c in self._pending
+                            if any(j == i for j, _ in c.owners))
+                exact = slot.t0 + len(slot.emitted)
+                bound = min(exact + self._chunk_span * later,
+                            self.total_len)
+                self._pos_est[i] = min(self._pos_est[i], bound)
+                if slot.pair is not None:
+                    # the uncond shadow's stream is the partner copy —
+                    # identical accepted lengths, identical pos
+                    self._pos_est[slot.pair] = \
+                        min(self._pos_est[slot.pair], bound)
             if len(toks):
                 # per-chunk decode attribution: one span per harvested
                 # chunk per request, tiling from the previous harvest
@@ -2548,11 +2662,31 @@ class Engine:
                     paged["prefill_p50_ms"] = _p50_ms(self.prefill_times)
                     paged["warm_admit_p50_ms"] = _p50_ms(
                         self.warm_admit_times)
+        spec = {}
+        if self.speculative:
+            k = self.speculative
+            spec = {
+                "speculative": k,
+                "draft_layers": self.draft_layers,
+                "spec_rounds": self.spec_rounds,
+                # delivered / proposed: the fraction of proposed
+                # positions that survived verify — 1/k is the total-
+                # rejection floor (the verify sample always lands), 1.0
+                # means every draft matched (end-of-sequence clamping
+                # is excluded from the denominator, so a perfect draft
+                # really scores 1.0)
+                "spec_acceptance_rate": round(
+                    self.spec_delivered / max(self.spec_proposed, 1),
+                    4),
+                "spec_tokens_per_round": round(
+                    self.spec_delivered / max(self.spec_rounds, 1), 3),
+            }
         return {
             "kv": self.kv,
             "kv_hbm_bytes": self.kv_hbm_bytes(),
             **self._mesh_stats(),
             **paged,
+            **spec,
             "queue_depth": self.queue.depth(),
             "active_slots": self.active_slots(),
             "num_slots": self.num_slots,

@@ -167,10 +167,21 @@ class Gateway:
             else 0
         weight_of = tenants.weight_of if tenants is not None \
             else (lambda name: 1.0)
+        # WFQ cost is measured in IMAGE TOKENS, not requests: every
+        # completion decodes exactly image_seq_len tokens, so charging
+        # that (instead of 1.0 per request) makes a tenant's share mean
+        # decoded work — a variable-resolution or fan-out tenant can't
+        # multiply its share by splitting work across more, smaller
+        # requests. Speculation doesn't change the charge: rejected
+        # drafts are never delivered, so the true per-request token
+        # cost is image_seq_len at every acceptance rate. Without a
+        # cfg there is no token count to meter — fall back to 1.0 per
+        # request (uniform cost keeps WFQ exact, just request-denominated)
+        cost = float(self.image_tokens) if self.image_tokens else 1.0
         self.queue = S.WeightedFairQueue(
             max_depth=queue_depth, max_prompt_len=max_prompt_len,
             clock=clock, on_event=self._event_sink,
-            weight_of=weight_of)
+            weight_of=weight_of, cost_fn=lambda request: cost)
         self._lock = threading.Lock()
         self._flights: Dict[int, _Flight] = {}
         self._events: "collections.deque" = collections.deque(

@@ -26,6 +26,19 @@ PAGES shared by every slot:
     of every fused K-step chunk, so growth never needs a mid-chunk
     host sync), and free-on-completion/expiry/eviction.
 
+Speculative decode (``speculative=k``) changes only the map-ahead
+HORIZON, never the lifecycle: the engine provisions ``chunk_steps × k``
+rows per chunk (the most a chunk can deliver at full acceptance)
+instead of ``chunk_steps``. There is NO allocation churn on rejection —
+a rejected draft's K/V rows sit above the slot's committed ``pos`` on
+already-mapped pages and are simply overwritten by the next round's
+k-wide write before ``pos`` ever crosses them, so pages are never
+unmapped, shrunk, or re-requested mid-request; ``pos`` (and therefore
+the page high-water mark) only moves forward. A low-acceptance slot
+just reaches its map-ahead pages later than the estimate assumed; the
+engine tightens the position estimate at every harvest so the horizon
+tracks delivered tokens, not drafted ones.
+
 Overcommit is the point: the engine may run more slots than
 ``num_pages`` could hold at full length, because concurrent requests sit
 at ragged positions. When the pool genuinely runs out mid-decode, the

@@ -275,6 +275,8 @@ class ReplicaSet:
                  num_pages: int = 0,
                  paged_attn: str = "gather",
                  sparse_reads: bool = False,
+                 speculative: int = 0,
+                 draft_layers: int = 0,
                  prefix_cache: bool = False,
                  clock: Callable[[], float] = time.perf_counter,
                  heartbeat_s: float = 5.0,
@@ -415,6 +417,7 @@ class ReplicaSet:
             log_every=log_every, quantize_cache=quantize_cache,
             kv=kv, page_size=page_size, num_pages=num_pages,
             paged_attn=paged_attn, sparse_reads=sparse_reads,
+            speculative=speculative, draft_layers=draft_layers,
             prefix_cache=prefix_cache)
         self.worker_ckpt = worker_ckpt
         if self.isolation == "process":
@@ -436,6 +439,7 @@ class ReplicaSet:
                 quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
                 paged_attn=paged_attn, sparse_reads=sparse_reads,
+                speculative=speculative, draft_layers=draft_layers,
                 prefix_cache=prefix_cache)
             # routing needs page math without an Engine in-process:
             # mirror the engine's bucket/page-size resolution

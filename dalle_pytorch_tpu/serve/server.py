@@ -55,6 +55,8 @@ class InferenceServer:
                  num_pages: int = 0,
                  paged_attn: str = "gather",
                  sparse_reads: bool = False,
+                 speculative: int = 0,
+                 draft_layers: int = 0,
                  prefix_cache: bool = False,
                  default_cfg_scale: float = 0.0,
                  replicas: int = 1,
@@ -178,6 +180,7 @@ class InferenceServer:
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
                 paged_attn=paged_attn, sparse_reads=sparse_reads,
+                speculative=speculative, draft_layers=draft_layers,
                 prefix_cache=prefix_cache,
                 heartbeat_s=heartbeat_s, isolation=isolation,
                 child_rss_limit_mb=child_rss_limit_mb,
@@ -218,6 +221,7 @@ class InferenceServer:
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
                 paged_attn=paged_attn, sparse_reads=sparse_reads,
+                speculative=speculative, draft_layers=draft_layers,
                 prefix_cache=prefix_cache,
                 weights_version=self.weights_version,
                 model_version=self.weights_version)
@@ -229,6 +233,7 @@ class InferenceServer:
                 log_every=log_every, quantize_cache=quantize_cache,
                 kv=kv, page_size=page_size, num_pages=num_pages,
                 paged_attn=paged_attn, sparse_reads=sparse_reads,
+                speculative=speculative, draft_layers=draft_layers,
                 prefix_cache=prefix_cache,
                 weights_version=self.weights_version,
                 model_version=self.weights_version)
