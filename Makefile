@@ -8,7 +8,8 @@
 # __graft_entry__.dryrun_multichip set up the 8-device CPU mesh themselves
 CPU_ENV := PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast dryrun bench-smoke bench demo-rehearsal demo lint
+.PHONY: test test-fast dryrun bench-smoke bench demo-rehearsal demo lint \
+	serve-stream
 
 test:            ## full suite on the virtual 8-device CPU mesh (~25 min)
 	$(CPU_ENV) python -m pytest tests/ -q
@@ -26,6 +27,18 @@ bench-smoke:     ## tiny CPU bench — structural check of every config
 
 bench:           ## full bench on the real chip (healthy tunnel required)
 	python bench.py
+
+serve-stream:    ## streaming/fan-out tier: unit tests + asserted bench leg
+	$(CPU_ENV) python -m pytest tests/test_stream.py tests/test_fanout.py \
+	    tests/test_ipc.py -q
+	$(CPU_ENV) XLA_FLAGS= python bench.py --tiny --config serve \
+	    --serve_fanout 4 --serve_requests 4 --serve_loads 8 \
+	    --serve_chunks 8 \
+	    | python -c "import json,sys; \
+	        r = json.load(sys.stdin); fc = r['fanout_compare']; \
+	        assert 'error' not in fc, fc; \
+	        assert 'error' not in r, r.get('error'); \
+	        print('serve-stream OK:', json.dumps(fc['best_of_n']))"
 
 demo-rehearsal:  ## end-to-end demo pipeline, tiny knobs, scratch dirs
 	$(CPU_ENV) OUT=/tmp/demo_rehearsal/out DATA=/tmp/demo_rehearsal/data \

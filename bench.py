@@ -1928,6 +1928,215 @@ def _serve_prefix_compare(*, num_slots=4, chunk_steps=8, n_samples=4):
     return out
 
 
+def _serve_fanout_compare(*, n_samples=4, chunk_steps=8):
+    """The streaming/fan-out tier record (docs/SERVING.md 'Streaming,
+    fan-out & variable resolution') — one InferenceServer (paged KV +
+    prefix cache + previews + CLIP rerank), four asserted legs:
+
+      * ``best_of_n``: ONE ``submit(n_samples=N, stream=True)`` call
+        returns a ranked group. Every sample completes OK; the group's
+        lifetime page peak is <= ONE prompt span + N generation spans
+        (the COW bound — strictly under N independent full maps), and
+        the engine's ``pages_shared`` proves the prompt prefill was
+        paid once; the ranked ``samples`` list is CLIP-score
+        descending.
+      * ``stream_identity``: the multiplexed SSE channel's per-sample
+        token events, reassembled by absolute position, are
+        byte-identical to each member's terminal result — and each
+        member's tokens are byte-identical to a STANDALONE non-streamed
+        request submitted with the derived ``sample_seed(seed, i)``
+        (streaming moves observation, never computation).
+      * ``preview_final``: each sample's ``final=True`` preview frame
+        unpacks bit-equal to its result image (same zero-padded row
+        through the same jitted VAE program, by construction).
+      * ``short_grid``: ``image_seq_len_override = L/2`` completes with
+        exactly L/2 tokens that are the PREFIX of the full-resolution
+        run at the same seed (the autoregressive stream is causal, so
+        a shorter grid is a truncation, not a different sample) —
+        train-free variable resolution riding the same programs.
+
+    All CPU-safe (pages / counts / byte-equality, no kernel timing);
+    raises AssertionError on violation — CI's serve-stream smoke greps
+    the structured ``"error"`` field like every sibling compare leg."""
+    import numpy as np
+
+    import jax
+
+    from dalle_pytorch_tpu.models import clip as C
+    from dalle_pytorch_tpu.models import dalle as D
+    from dalle_pytorch_tpu.models import vae as V
+    from dalle_pytorch_tpu.serve import pages_for, sample_seed, \
+        unpack_image
+    from dalle_pytorch_tpu.serve.server import InferenceServer
+
+    # tied codebook: vae.codebook_dim must equal the dalle dim
+    vcfg = V.VAEConfig(image_size=16, num_tokens=32, codebook_dim=32,
+                       num_layers=2, hidden_dim=8)
+    cfg = D.DALLEConfig(dim=32, depth=2, vae=vcfg, num_text_tokens=64,
+                        text_seq_len=16, heads=2, dim_head=16)
+    ccfg = C.CLIPConfig(dim_text=32, dim_image=32, dim_latent=24,
+                        num_text_tokens=cfg.num_text_tokens,
+                        text_enc_depth=2,
+                        text_seq_len=cfg.text_seq_len, text_heads=2,
+                        visual_enc_depth=2, visual_heads=2,
+                        visual_image_size=vcfg.image_size,
+                        visual_patch_size=8, sparse_attn=False)
+    key = jax.random.PRNGKey(0)
+    vae_params = V.vae_init(jax.random.fold_in(key, 1), vcfg)
+    params = jax.device_put(D.dalle_init(key, cfg, vae_params))
+    clip_params = jax.device_put(C.clip_init(
+        jax.random.fold_in(key, 2), ccfg))
+
+    n = int(n_samples)
+    page_size = 8
+    prompt = tuple(1 + (i % 7) for i in range(cfg.text_seq_len))
+    t0 = len(prompt)
+    full = pages_for(cfg.seq_len, page_size)
+    shared = t0 // page_size
+    # the COW bound the acceptance names: the prompt span allocated
+    # ONCE plus N private generation spans
+    bound = shared + n * (full - shared)
+    out = {"n_samples": n, "page_size": page_size, "prompt_len": t0,
+           "seq_len": cfg.seq_len, "chunk_steps": chunk_steps,
+           "asserted": True}
+
+    server = InferenceServer(
+        params, vae_params, cfg, num_slots=max(n, 2),
+        queue_depth=4 * n + 8, chunk_steps=chunk_steps, kv="paged",
+        page_size=page_size, prefix_cache=True, preview_every=2,
+        clip_params=clip_params, clip_cfg=ccfg,
+        weights_version="v0").start()
+    try:
+        # -- best_of_n FIRST: a clean lifetime page peak -----------------
+        _progress(f"fanout: best-of-{n} group (compiles prefill + "
+                  f"fused decode + VAE + CLIP)")
+        group = server.submit(prompt, seed=7, n_samples=n, stream=True)
+        streamed: dict = {i: {} for i in range(n)}   # pos -> tokens
+        finals: dict = {}
+        events = 0
+        for ev in group.sink.events():
+            events += 1
+            if ev["event"] == "tokens":
+                streamed[ev["sample"]][ev["pos"]] = ev["tokens"]
+            elif ev["event"] == "preview" and ev.get("final"):
+                finals[ev["sample"]] = unpack_image(ev["image"])
+        res = group.result(timeout=300)
+        if not res.ok:
+            raise AssertionError(
+                f"best-of-{n} group failed: {res.status} ({res.reason})")
+        if len(res.samples) != n \
+                or any(not s.ok for s in res.samples):
+            raise AssertionError(
+                f"group must complete ALL {n} samples: "
+                f"{[s.status for s in res.samples]}")
+        scores = [s.clip_score for s in res.samples]
+        if any(sc is None for sc in scores) \
+                or any(a < b for a, b in zip(scores, scores[1:])):
+            raise AssertionError(
+                f"samples must be CLIP-score ranked descending, got "
+                f"{scores}")
+        peak = server.engine.alloc.peak_in_use
+        snap = server.engine.stats()
+        if peak > bound:
+            raise AssertionError(
+                f"fanout peak {peak} pages > COW bound {bound} (1 "
+                f"prompt span + {n} generation spans) — the shared "
+                f"prompt must be allocated once")
+        # pages_shared is a live gauge (drops back once the group's refs
+        # release); the cumulative proof the prompt was paid once is the
+        # warm-hit count + the retains the siblings took on the leader's
+        # span (each warm sibling retains `shared` pages instead of
+        # allocating them)
+        if snap["prefix_hits"] < n - 1 \
+                or server.engine.alloc.retains < (n - 1) * shared:
+            raise AssertionError(
+                f"prompt span not shared: prefix_hits="
+                f"{snap['prefix_hits']} (want >= {n - 1}), retains="
+                f"{server.engine.alloc.retains} (want >= "
+                f"{(n - 1) * shared})")
+        out["best_of_n"] = {
+            "completed": n, "events": events,
+            "peak_pages": int(peak), "peak_pages_bound": int(bound),
+            "prefix_hits": int(snap["prefix_hits"]),
+            "pages_retained": int(server.engine.alloc.retains),
+            "best_clip_score": round(float(scores[0]), 6),
+        }
+
+        # -- stream_identity: SSE bytes == results == standalones --------
+        _progress("fanout: streamed-vs-standalone byte identity")
+        members = [m.result(timeout=5) for m in group.members]
+        mismatches = 0
+        for i, m in enumerate(members):
+            toks = []
+            for pos in sorted(streamed[i]):
+                toks.extend(streamed[i][pos])
+            want = np.asarray(m.tokens)
+            got = np.asarray(toks[-len(m.tokens):], want.dtype)
+            if not np.array_equal(got, want):
+                mismatches += 1
+            alone = server.generate(prompt, seed=sample_seed(7, i),
+                                    timeout=300)
+            if not alone.ok or not np.array_equal(
+                    np.asarray(alone.tokens), want):
+                mismatches += 1
+        if mismatches:
+            raise AssertionError(
+                f"stream identity broke: {mismatches} of {n} samples "
+                f"diverged between the SSE event stream, the member "
+                f"result, and the standalone sample_seed run")
+        if any(i not in finals for i in range(n)) or any(
+                not np.array_equal(finals[i], members[i].image)
+                for i in range(n)):
+            raise AssertionError(
+                "final preview frame != non-streamed result image — "
+                "the closing SSE frame must be the result, bit-exact")
+        out["stream_identity"] = {"token_mismatches": 0,
+                                  "final_frames": len(finals)}
+
+        # -- short_grid: override is a prefix of the full-res run --------
+        _progress("fanout: image_seq_len_override prefix identity")
+        L = cfg.image_seq_len // 2
+        short = server.generate(prompt, seed=7,
+                                image_seq_len_override=L, timeout=300)
+        if not short.ok or len(short.tokens) != L:
+            raise AssertionError(
+                f"override run: {short.status}, "
+                f"{len(short.tokens or ())} tokens (want {L})")
+        full_run = server.generate(prompt, seed=7, timeout=300)
+        if not np.array_equal(np.asarray(short.tokens),
+                              np.asarray(full_run.tokens)[:L]):
+            raise AssertionError(
+                "override tokens are not the full-resolution prefix — "
+                "the short grid must truncate the same causal stream")
+        if short.image is None or short.image.shape \
+                != full_run.image.shape:
+            raise AssertionError(
+                "override result must still decode a full-shape image "
+                "from the zero-padded prefix row")
+        out["short_grid"] = {"override": L,
+                             "tokens": len(short.tokens)}
+
+        # -- the stats surface the CI smoke greps ------------------------
+        st = server.stats()
+        if st["groups_completed"] < 1 \
+                or st["fanout_pages_saved"] < (n - 1) * shared \
+                or st["preview_frames"] < n:
+            raise AssertionError(
+                f"stats must bank the group: groups_completed="
+                f"{st['groups_completed']} fanout_pages_saved="
+                f"{st['fanout_pages_saved']} preview_frames="
+                f"{st['preview_frames']}")
+        out["stats"] = {
+            "groups_completed": st["groups_completed"],
+            "fanout_pages_saved": st["fanout_pages_saved"],
+            "preview_frames": st["preview_frames"],
+            "streams_active": st["streams_active"],
+        }
+    finally:
+        server.close()
+    return out
+
+
 def _serve_replica_compare(params, cfg, *, replicas, num_slots, n_req,
                            kv, page_size, chunk_steps=8):
     """The replica-set headline: N supervised engines behind one queue
@@ -3165,6 +3374,18 @@ def bench_serve(args):
             migration_compare = {"error": f"{type(e).__name__}: {e}"}
             errors.append(str(e))
 
+    fanout_compare = None
+    if args.serve_fanout:
+        _progress(f"serve: streaming best-of-{args.serve_fanout} "
+                  f"fan-out + COW page bound + preview identity")
+        try:
+            fanout_compare = _serve_fanout_compare(
+                n_samples=args.serve_fanout)
+        except Exception as e:  # noqa: BLE001 — structured-error
+            # contract: the serve-stream CI leg greps for it
+            fanout_compare = {"error": f"{type(e).__name__}: {e}"}
+            errors.append(str(e))
+
     gateway_compare = None
     if args.serve_gateway:
         _progress("serve: gateway tier — affinity-vs-hash-blind "
@@ -3209,6 +3430,8 @@ def bench_serve(args):
         record["spec_compare"] = spec_compare
     if migration_compare is not None:
         record["migration_compare"] = migration_compare
+    if fanout_compare is not None:
+        record["fanout_compare"] = fanout_compare
     if gateway_compare is not None:
         record["gateway_compare"] = gateway_compare
     if errors:
@@ -3390,6 +3613,20 @@ def main():
                          "50% of what replay re-decoded, all asserted "
                          "(docs/SERVING.md 'Live migration & "
                          "disaggregated roles')")
+    ap.add_argument("--serve_fanout", type=int, default=0,
+                    help="bench_serve: run the fanout_compare leg with "
+                         "best-of-N groups (0 = off) — one "
+                         "submit(n_samples=N, stream=True) call must "
+                         "complete all N CLIP-ranked samples with a "
+                         "lifetime page peak <= 1 prompt span + N "
+                         "generation spans (the COW bound), every "
+                         "sample's SSE token stream byte-identical to "
+                         "a standalone sample_seed run, the final "
+                         "preview frame bit-equal to the result image, "
+                         "and image_seq_len_override a causal prefix "
+                         "of the full-resolution run, all asserted "
+                         "(docs/SERVING.md 'Streaming, fan-out & "
+                         "variable resolution')")
     ap.add_argument("--serve_gateway", action="store_true",
                     help="bench_serve: run the gateway_compare leg — "
                          "two 2-cell fleets route the same repeated-"

@@ -271,6 +271,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue_depth", type=int, default=64,
                    help="bounded admission queue; submissions past this "
                         "are rejected with a structured 429")
+    p.add_argument("--preview_every", type=int, default=0,
+                   help="progressive previews for streamed requests "
+                        "(POST /generate {\"stream\": true}): every N "
+                        "harvested chunks the postprocess thread decodes "
+                        "the image-token PREFIX through the VAE and "
+                        "pushes a 'preview' SSE frame — the image "
+                        "sharpens as tokens land, and the final frame is "
+                        "byte-identical to the non-streamed result. 0 = "
+                        "token streaming only, no intermediate frames "
+                        "(docs/SERVING.md 'Streaming, fan-out & variable "
+                        "resolution'). Thread-isolation replicas only")
+    p.add_argument("--stream_max_events", type=int, default=256,
+                   help="per-stream event ring size: a consumer that "
+                        "falls this far behind sheds its OLDEST pending "
+                        "tokens/preview events (typed 'overflow' event "
+                        "names the gap; the terminal result is always "
+                        "complete) — the engine never blocks on a slow "
+                        "SSE reader")
     p.add_argument("--admin_token", type=str, default="",
                    help="bearer token for the POST /admin/scale "
                         "operator endpoint (add/remove/drain/undrain "
@@ -452,6 +470,8 @@ def main(argv=None):
         speculative=args.speculative, draft_layers=args.draft_layers,
         prefix_cache=args.prefix_cache,
         default_cfg_scale=args.cfg_scale,
+        preview_every=args.preview_every,
+        stream_max_events=args.stream_max_events,
         replicas=args.replicas, mesh_devices=args.mesh_devices,
         replica_roles=(args.replica_roles.split(",")
                        if args.replica_roles else None),

@@ -16,6 +16,11 @@ from dalle_pytorch_tpu.serve.scheduler import (  # noqa: F401
     QueueClosed, QueueFull, Request, RequestHandle, RequestQueue, Result,
     SamplingParams, ServeRejected, WeightedFairQueue, bucket_for,
     group_by_bucket, prefill_buckets)
+from dalle_pytorch_tpu.serve.fanout import (  # noqa: F401
+    GroupFuture, group_pages_saved, rank_samples, sample_seed,
+    submit_group)
+from dalle_pytorch_tpu.serve.stream import (  # noqa: F401
+    TokenSink, sse_bytes, unpack_image)
 from dalle_pytorch_tpu.serve.tenancy import (  # noqa: F401
     TIERS, AuthError, TenantSpec, TenantTable, TenantThrottled,
     TokenBucket)

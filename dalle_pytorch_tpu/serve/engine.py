@@ -123,7 +123,7 @@ from dalle_pytorch_tpu.serve import scheduler as S
 COUNTERS = ("tokens_decoded", "decode_steps", "harvests",
             "occupancy_sum", "completed", "expired",
             "decode_traces", "prefill_traces", "evicted",
-            "prefix_hits", "cfg_pairs")
+            "prefix_hits", "cfg_pairs", "reaped")
 
 
 class ProfileError(RuntimeError):
@@ -193,20 +193,31 @@ class _Slot:
     ``pair`` (its uncond partner's index) and the uncond SHADOW slot
     carries ``shadow_of`` (the cond index) — the shadow holds the same
     handle but is never credited, completed, or evicted on its own; it
-    lives and dies with its cond slot."""
+    lives and dies with its cond slot.
+
+    ``need`` is the request's total emit budget (text fill + image
+    span) when ``image_seq_len_override`` caps the grid, None for a
+    full-length request: harvest truncates the final chunk at the
+    budget and completes the slot early — the device keeps the full
+    sequence shape (one compiled program), the host just stops
+    delivering at the override span. ``since_preview`` counts harvested
+    chunks since the last progressive-preview request (streaming)."""
 
     __slots__ = ("handle", "t0", "emitted", "t_admit", "pair",
-                 "shadow_of")
+                 "shadow_of", "need", "since_preview")
 
     def __init__(self, handle: S.RequestHandle, t0: int, t_admit: float,
                  pair: Optional[int] = None,
-                 shadow_of: Optional[int] = None):
+                 shadow_of: Optional[int] = None,
+                 need: Optional[int] = None):
         self.handle = handle
         self.t0 = t0
         self.emitted: List[int] = []
         self.t_admit = t_admit
         self.pair = pair
         self.shadow_of = shadow_of
+        self.need = need
+        self.since_preview = 0
 
 
 class _Chunk:
@@ -286,6 +297,7 @@ class Engine:
                  draft_layers: int = 0,
                  prefix_cache: bool = False,
                  prefix_entries: int = 256,
+                 preview_every: int = 0,
                  model_version: str = "0",
                  weights_version: str = "0",
                  time_admissions: bool = False,
@@ -550,6 +562,18 @@ class Engine:
         self.time_admissions = bool(time_admissions)
         self.prefill_times: List[float] = []
         self.warm_admit_times: List[float] = []
+        # progressive image previews (streaming): every preview_every
+        # harvested chunks per streaming slot, hand the image-token
+        # prefix to on_preview (the postprocess stage pads it to the
+        # full grid and decodes it through the ONE batch-1 VAE program
+        # — serve/postprocess.py). 0 disables; the hook is set by the
+        # server after construction, like ``complete``.
+        self.preview_every = int(preview_every)
+        if self.preview_every < 0:
+            raise ValueError(f"preview_every must be >= 0, got "
+                             f"{preview_every}")
+        self.on_preview: Optional[Callable] = None
+        self.previews_requested = 0
         self._pending: deque = deque()   # dispatched, un-harvested chunks
         # memo for the config-static /stats read-bytes model, keyed by
         # the sparse_reads flag it was asked for
@@ -582,6 +606,9 @@ class Engine:
         #                                 counted when the hit is USED,
         #                                 not merely probed)
         self.cfg_pairs = 0              # guided pairs admitted
+        self.reaped = 0                 # externally-cancelled slots
+        #                                 reclaimed (stream disconnect,
+        #                                 group cancel, hedge loser)
         self.decode_steps = 0           # fused steps dispatched (chunks*K)
         self.harvests = 0               # emit-ring device_gets — the ONLY
         #                                 host syncs in steady state
@@ -1206,6 +1233,11 @@ class Engine:
         free = [i for i, s in enumerate(self.slots) if s is None]
         valid = []
         for h in handles:
+            if h.done():
+                # cancelled while queued (stream disconnect, group
+                # cancel, hedge loser): its terminal result already
+                # stuck — slotting it would decode tokens nobody reads
+                continue
             # the server's queue validates at submit; a raw queue may
             # not — a prompt the pool can't hold must become a typed
             # error result, never a crash of the serving loop
@@ -1217,6 +1249,12 @@ class Engine:
             if h.request.cfg_scale > 0 and self.num_slots < 2:
                 self._error(h, now, "cfg_scale needs a cond/uncond "
                             "slot pair: num_slots must be >= 2")
+                continue
+            L = int(h.request.image_seq_len_override)
+            if L and not 1 <= L <= self.cfg.image_seq_len:
+                self._error(h, now, f"image_seq_len_override {L} out "
+                            f"of range (need 1.."
+                            f"{self.cfg.image_seq_len})")
                 continue
             valid.append(h)
         # slot budget in arrival order: a guided request takes TWO
@@ -1422,7 +1460,9 @@ class Engine:
             t_slotted = self.clock()
             for p in group:
                 i = p.slot
-                self.slots[i] = _Slot(p.handle, p.t0, now)
+                self.slots[i] = _Slot(p.handle, p.t0, now,
+                                      need=self._slot_need(
+                                          p.handle.request, p.t0))
                 if self.kv == "paged":
                     self._slot_pages[i] = list(p.grants)
                     self._pos_est[i] = p.t0
@@ -1456,6 +1496,18 @@ class Engine:
         n_top_p[j] = np.float32(req.sampling.top_p)
         n_cfgs[j] = np.float32(req.cfg_scale)
         n_uncond[j] = p.uncond
+
+    def _slot_need(self, req: S.Request, t0: int) -> Optional[int]:
+        """The slot's total emit budget under ``image_seq_len_override``
+        (text fill + capped image span), None for a full-length request.
+        Decode stops at the budget on the HOST — harvest truncates the
+        final chunk and completes the slot early — so the one compiled
+        full-length program serves every override; the cost ceiling is
+        at most one chunk of wasted device steps past the cap."""
+        L = int(req.image_seq_len_override)
+        if not L:
+            return None
+        return (self.cfg.text_seq_len - t0) + L
 
     def _unique_handles(self, group: List[_Row]) -> List[S.RequestHandle]:
         out, seen = [], set()
@@ -1629,7 +1681,9 @@ class Engine:
         t_slotted = self.clock()
         for p in warm:
             i = p.slot
-            self.slots[i] = _Slot(p.handle, p.t0, now)
+            self.slots[i] = _Slot(p.handle, p.t0, now,
+                                  need=self._slot_need(
+                                      p.handle.request, p.t0))
             self._slot_pages[i] = list(p.entry.full_pages) + \
                 list(p.grants)
             self._pos_est[i] = p.t0
@@ -1893,6 +1947,7 @@ class Engine:
         # heartbeat deadline measure real progress, not loop liveness
         self.last_heartbeat = now
         emitted = 0
+        kill: List[int] = []
         for i, slot in rec.owners:
             if slot.shadow_of is not None:
                 # uncond shadow of a guided pair: its ring row mirrors
@@ -1911,8 +1966,42 @@ class Engine:
                 continue
             row = ring[i]
             toks = row[row >= 0]
+            capped = False
+            if slot.need is not None:
+                # image_seq_len_override: the device decodes the full
+                # sequence shape, the host stops delivering at the
+                # budget — truncate the final chunk and complete early
+                left = slot.need - len(slot.emitted)
+                if len(toks) >= left:
+                    toks = toks[:left]
+                    capped = True
             slot.emitted.extend(int(t) for t in toks)
             emitted += len(toks)
+            sink = slot.handle.sink
+            if sink is not None and len(toks):
+                # live token stream: positions are absolute sequence
+                # offsets (>= text_seq_len means image tokens), which
+                # is what lets the sink dedupe an eviction/failover
+                # REPLAY — re-delivered positions below its high-water
+                # mark are dropped, so the consumer sees each position
+                # exactly once. Never blocks: overflow is the sink's
+                # typed drop policy, not engine backpressure.
+                sink.push_tokens(
+                    slot.t0 + len(slot.emitted) - len(toks),
+                    [int(t) for t in toks])
+                if (self.on_preview is not None and self.preview_every
+                        and not capped):
+                    slot.since_preview += 1
+                    img_done = len(slot.emitted) \
+                        - (self.cfg.text_seq_len - slot.t0)
+                    if slot.since_preview >= self.preview_every \
+                            and img_done > 0:
+                        slot.since_preview = 0
+                        self.previews_requested += 1
+                        prefix = np.asarray(
+                            slot.emitted[self.cfg.text_seq_len
+                                         - slot.t0:], np.int32)
+                        self.on_preview(slot.handle, prefix)
             if self.speculative:
                 # acceptance accounting over DELIVERED tokens only: a
                 # round's k-wide ring window holds its accepted prefix,
@@ -1958,8 +2047,22 @@ class Engine:
                 # decode milliseconds actually went
                 self._span(slot.handle, "decode_chunk", now,
                            tokens=int(len(toks)))
-            if not bool(active_after[i]):
+            if capped:
+                # the budget is met mid-sequence: the device bit is
+                # still up, so completion must also kill the slot's
+                # mask entry (and its shadow's) or the freed slot
+                # would keep decoding a ghost
+                pair = slot.pair
                 self._complete(i, slot, now)
+                kill.append(i)
+                if pair is not None:
+                    kill.append(pair)
+            elif not bool(active_after[i]):
+                self._complete(i, slot, now)
+        if kill:
+            keep = np.ones((self.num_slots,), bool)
+            keep[kill] = False
+            self.active = self._kill_fn(self.active, self._put(keep))
         self.tokens_decoded += emitted
         self.occupancy_sum += emitted
 
@@ -1968,7 +2071,10 @@ class Engine:
         state already parked itself inside the fused program)."""
         req = slot.handle.request
         full = list(req.codes) + slot.emitted
-        img_seq = np.asarray(full[-self.cfg.image_seq_len:], np.int32)
+        # override requests deliver their capped span (full holds
+        # text_seq_len + L tokens then — the host stopped at the budget)
+        L = int(req.image_seq_len_override) or self.cfg.image_seq_len
+        img_seq = np.asarray(full[-L:], np.int32)
         # the completed text span (prompt + sampled text tokens) —
         # generate_images' full[:, :text_seq_len], what CLIP rerank
         # scores (postprocess.py)
@@ -2229,7 +2335,13 @@ class Engine:
                 raise MigrationError("transfer", repr(e)) from e
             i = idx[0]
             t0 = int(payload["t0"])
-            self.slots[i] = _Slot(handle, t0, now)
+            # the emit budget is re-derived from the request riding the
+            # payload's wire form (legacy frames decode override=0 →
+            # full length), so a capped request completes at the same
+            # token on the target as it would have at the source
+            self.slots[i] = _Slot(handle, t0, now,
+                                  need=self._slot_need(handle.request,
+                                                       t0))
             self.slots[i].emitted = [int(t) for t in payload["emitted"]]
             if len(parts) == 2:
                 j = idx[1]
@@ -2283,6 +2395,20 @@ class Engine:
             for i, slot in enumerate(self.slots):
                 if slot is None or slot.shadow_of is not None:
                     continue        # a shadow expires with its cond slot
+                if slot.handle.done():
+                    # cancelled externally mid-decode (stream client
+                    # disconnected, group cancelled, hedge lost): the
+                    # terminal result already stuck via first-write-
+                    # wins — reclaim the slot and its pages NOW instead
+                    # of decoding to the end for nobody
+                    self.reaped += 1
+                    if self.metrics is not None:
+                        self.metrics.event(**S.structured_event(
+                            "serve_slot_reaped",
+                            request_id=slot.handle.request.request_id,
+                            tokens_done=len(slot.emitted)))
+                    kill.extend(self._free_slot(i))
+                    continue
                 dt = slot.handle.request.deadline_t
                 if dt is not None and now > dt:
                     self._expire(slot.handle, now, where="decoding")
@@ -2700,6 +2826,8 @@ class Engine:
             "completed": self.completed,
             "expired": self.expired,
             "cfg_pairs": self.cfg_pairs,
+            "reaped": self.reaped,
+            "previews_requested": self.previews_requested,
             "rejected": self.queue.rejected,
             "decode_compiles": self.decode_traces,
             "prefill_compiles": self.prefill_traces,

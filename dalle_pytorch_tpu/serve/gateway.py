@@ -104,6 +104,12 @@ class _Flight:
     dispatch_t: float = 0.0
     replays: int = 0
     released: bool = False
+    # gateway-owned stream sinks (serve/stream.py), built ONCE at
+    # admission and handed to every dispatch of this flight — replay on
+    # a survivor cell re-feeds the SAME sinks, and the per-sink
+    # high-water mark dedupes the replayed prefix, so the client's
+    # stream never stutters across a cell death
+    sinks: Optional[List] = None
 
 
 # federation: the cell counters the gateway re-exposes with a ``cell``
@@ -167,21 +173,29 @@ class Gateway:
             else 0
         weight_of = tenants.weight_of if tenants is not None \
             else (lambda name: 1.0)
-        # WFQ cost is measured in IMAGE TOKENS, not requests: every
-        # completion decodes exactly image_seq_len tokens, so charging
-        # that (instead of 1.0 per request) makes a tenant's share mean
-        # decoded work — a variable-resolution or fan-out tenant can't
-        # multiply its share by splitting work across more, smaller
-        # requests. Speculation doesn't change the charge: rejected
-        # drafts are never delivered, so the true per-request token
-        # cost is image_seq_len at every acceptance rate. Without a
-        # cfg there is no token count to meter — fall back to 1.0 per
-        # request (uniform cost keeps WFQ exact, just request-denominated)
-        cost = float(self.image_tokens) if self.image_tokens else 1.0
+        # WFQ cost is measured in IMAGE TOKENS, not requests: a
+        # completion decodes its image span per sample, so the charge
+        # is n_samples x (override or full image_seq_len) — a fan-out
+        # tenant pays for N samples' decoded work up front, and a
+        # variable-resolution tenant can't multiply its share by
+        # splitting work across more, smaller requests (the short grid
+        # costs exactly its shorter span). Speculation doesn't change
+        # the charge: rejected drafts are never delivered, so the true
+        # per-sample token cost is the span at every acceptance rate.
+        # Without a cfg there is no token count to meter — fall back to
+        # n_samples per request (uniform per-sample cost keeps WFQ
+        # exact, just sample-denominated).
+        def _wfq_cost(request: S.Request) -> float:
+            n = max(int(request.n_samples), 1)
+            if not self.image_tokens:
+                return float(n)
+            span = int(request.image_seq_len_override) \
+                or self.image_tokens
+            return float(n * span)
         self.queue = S.WeightedFairQueue(
             max_depth=queue_depth, max_prompt_len=max_prompt_len,
             clock=clock, on_event=self._event_sink,
-            weight_of=weight_of, cost_fn=lambda request: cost)
+            weight_of=weight_of, cost_fn=_wfq_cost)
         self._lock = threading.Lock()
         self._flights: Dict[int, _Flight] = {}
         self._events: "collections.deque" = collections.deque(
@@ -197,6 +211,7 @@ class Gateway:
         self.cell_downs = 0
         self.completed = 0
         self.expired = 0
+        self.hedge_stream_rejects = 0
         # per-tenant e2e latency (submit -> terminal fulfil), the
         # histogram the degradation contract's p95 is read from
         self.registry = oreg.Registry()
@@ -259,26 +274,75 @@ class Gateway:
 
     # -- admission -----------------------------------------------------
 
+    def _sample_span(self, override: int) -> int:
+        """Per-sample decoded image span: the override grid when the
+        request carries one, else the model's full span."""
+        return int(override) or self.image_tokens
+
+    def _flight_pages(self, n_samples: int, override: int) -> int:
+        """The tenant page charge for one flight, COW-aware: a best-of-
+        N group shares its prompt span across all N members (PR 13's
+        refcounted prefix pages), so the true footprint is ONE prompt
+        span plus N generation spans — not N full requests. Scaled in
+        ``pages_per_request`` units off the model's token geometry; a
+        short-grid override shrinks the per-sample generation share
+        proportionally. Without a cfg the geometry is unknown — charge
+        the conservative N x pages_per_request."""
+        base = self.pages_per_request
+        n = max(int(n_samples), 1)
+        if base == 0:
+            return 0
+        if n == 1 and not override:
+            return base
+        if self.cfg is None:
+            return base * n
+        text = int(self.cfg.text_seq_len)
+        gen = self._sample_span(override)
+        full = text + int(self.cfg.image_seq_len)
+        cow = text + n * gen
+        return max(int(round(base * cow / full)), 1)
+
     def submit(self, codes, *, api_key: str = "", seed: int = 0,
                temperature: float = 1.0, filter_thres: float = 0.5,
                top_p: float = 0.0, priority: int = 0,
                deadline_s: Optional[float] = None,
-               cfg_scale: float = 0.0) -> S.RequestHandle:
+               cfg_scale: float = 0.0,
+               stream: bool = False,
+               n_samples: int = 1,
+               image_seq_len_override: int = 0) -> S.RequestHandle:
         """The fleet submit: authenticate -> charge tenant quotas ->
         enter the weighted-fair queue. Raises the typed ladder:
         ``tenancy.AuthError`` (401), ``tenancy.TenantThrottled`` (429
         with retry-after), ``scheduler.QueueFull`` / ``InvalidRequest``
         / ``QueueClosed`` — every refusal structured, nothing silent.
         The returned handle is the caller's future; the pump thread
-        routes, hedges, and replays behind it."""
+        routes, hedges, and replays behind it. ``stream``/``n_samples``
+        /``image_seq_len_override`` ride through to the cell: the
+        tenant is charged n_samples x the per-sample span up front
+        (decoded-work metering), and the page reservation charges the
+        COW footprint, not N cold prefills."""
+        n_samples = max(int(n_samples), 1)
+        override = int(image_seq_len_override)
         tenant = ""
         pages = 0
         if self.tenants is not None:
             spec = self.tenants.authenticate(api_key)
             tenant = spec.name
-            pages = self.pages_per_request
-            self.tenants.admit(tenant, image_tokens=self.image_tokens,
-                               pages=pages)
+            pages = self._flight_pages(n_samples, override)
+            self.tenants.admit(
+                tenant,
+                image_tokens=n_samples * self._sample_span(override),
+                pages=pages)
+        sinks = None
+        if stream:
+            from dalle_pytorch_tpu.serve.stream import TokenSink
+            sinks = (TokenSink.group(n_samples) if n_samples > 1
+                     else [TokenSink()])
+            for s in sinks:
+                # cell-side failover cancels must not end the client's
+                # stream — the replayed dispatch re-feeds these sinks;
+                # _finish force-closes them at the flight's terminal
+                s.replayable = True
         try:
             handle = self.queue.submit(S.Request(
                 codes=tuple(int(c) for c in codes), seed=int(seed),
@@ -287,7 +351,10 @@ class Gateway:
                     filter_thres=float(filter_thres),
                     top_p=float(top_p)),
                 priority=int(priority), deadline_s=deadline_s,
-                cfg_scale=float(cfg_scale), tenant=tenant))
+                cfg_scale=float(cfg_scale), tenant=tenant,
+                stream=bool(stream), n_samples=n_samples,
+                image_seq_len_override=override),
+                sink=sinks[0] if sinks else None)
         except S.ServeRejected:
             if self.tenants is not None:
                 # all-or-nothing admission: a queue refusal refunds
@@ -297,7 +364,8 @@ class Gateway:
             raise
         with self._lock:
             self._flights[handle.request.request_id] = _Flight(
-                handle=handle, tenant=tenant, pages=pages)
+                handle=handle, tenant=tenant, pages=pages,
+                sinks=sinks)
         return handle
 
     def generate(self, codes, timeout: Optional[float] = None,
@@ -366,7 +434,24 @@ class Gateway:
                 filter_thres=r.sampling.filter_thres,
                 top_p=r.sampling.top_p, priority=r.priority,
                 deadline_s=deadline, cfg_scale=r.cfg_scale,
-                tenant=r.tenant)
+                tenant=r.tenant, stream=r.stream,
+                n_samples=r.n_samples,
+                image_seq_len_override=r.image_seq_len_override,
+                # the gateway's sinks, not fresh cell-side ones: a
+                # replay re-feeds the same sinks and the high-water
+                # mark dedupes, so the client stream survives the hop
+                sinks=flight.sinks)
+        except S.InvalidRequest as e:
+            # the CELL can never run this request (e.g. streaming into
+            # a process-isolated cell): retrying elsewhere in the same
+            # fleet shape would spin forever — terminal typed error
+            flight.handle.fulfill(S.Result(
+                status=S.ERROR,
+                request_id=flight.handle.request.request_id,
+                reason=str(e.record.get("reason", "invalid_request"))))
+            self._finish(flight.handle.request.request_id,
+                         completed=False)
+            return None
         except S.ServeRejected:
             return None
         cell.inflight += 1
@@ -479,6 +564,23 @@ class Gateway:
         if flight is None or flight.released:
             return
         flight.released = True
+        if flight.sinks:
+            # the flight's terminal IS the stream's terminal: force-
+            # close every member sink so the SSE loop ends even when
+            # the cell-side arms never got to fulfil (replay budget
+            # exhausted, shutdown, disconnect)
+            try:
+                result = flight.handle.result(timeout=0)
+            except TimeoutError:
+                result = S.Result(
+                    status=S.CANCELLED,
+                    request_id=flight.handle.request.request_id,
+                    reason="gateway flight terminated")
+            for s in flight.sinks:
+                try:
+                    s.close(result, force=True)
+                except Exception:   # noqa: BLE001 — sink teardown must
+                    pass            # never block tenant-page release
         if self.tenants is not None and flight.tenant:
             self.tenants.release(flight.tenant, pages=flight.pages,
                                  completed=completed)
@@ -507,7 +609,16 @@ class Gateway:
         with self._lock:
             flights = list(self._flights.values())
         for fl in flights:
-            if fl.handle.done():        # e.g. expired while queued
+            if fl.handle.done():        # expired while queued, or the
+                # caller went away (SSE disconnect cancel): any live
+                # cell-side arm must be cancelled too, so the engine's
+                # done-handle reap frees its slots and pages instead
+                # of decoding a stream nobody is reading
+                for c, h in ((fl.cell, fl.cell_handle),
+                             (fl.hedge_cell, fl.hedge_handle)):
+                    if h is not None and not h.done():
+                        self._cancel_cell_handle(
+                            c, h, "gateway flight terminated")
                 self._finish(fl.handle.request.request_id,
                              completed=False)
                 continue
@@ -573,6 +684,19 @@ class Gateway:
             hedge_after = spec.hedge_after_s
             if hedge_after is None or \
                     now - fl.dispatch_t < hedge_after:
+                continue
+            if fl.handle.request.stream:
+                # two live arms would BOTH feed the client's sinks —
+                # interleaved duplicate events, not a latency win. A
+                # slow stream keeps its single arm; the refusal is
+                # typed so the operator can see hedging declined.
+                self.hedge_stream_rejects += 1
+                self._event("gateway_hedge_reject",
+                            request=fl.handle.request.request_id,
+                            tenant=fl.tenant, reason="stream",
+                            after_s=round(now - fl.dispatch_t, 4))
+                # stamp so the sweep doesn't re-refuse every tick
+                fl.dispatch_t = now
                 continue
             by_index = {c.index: c for c in self.cells if c.alive()}
             target = next(
@@ -642,6 +766,10 @@ class Gateway:
             "spills": self.spills,
             "hedges": self.hedges,
             "hedge_wins": self.hedge_wins,
+            "hedge_stream_rejects": self.hedge_stream_rejects,
+            "streams_active": sum(
+                1 for fl in list(self._flights.values())
+                if fl.sinks and not fl.sinks[0].done),
             "replays": self.replays,
             "cell_downs": self.cell_downs,
             "completed": self.completed,
@@ -672,6 +800,9 @@ class Gateway:
             ("dalle_gateway_hedges_total",
              "Speculative duplicate sends past the SLO-tier threshold",
              [(None, self.hedges)]),
+            ("dalle_gateway_hedge_stream_rejects_total",
+             "Hedges refused because the flight is a live stream",
+             [(None, self.hedge_stream_rejects)]),
             ("dalle_gateway_replays_total",
              "Zero-loss replays after a cell death or reject",
              [(None, self.replays)]),
@@ -707,6 +838,9 @@ class Gateway:
              [(None, stats["queue_depth"])]),
             ("dalle_gateway_alive_cells", "Cells currently serving",
              [(None, stats["alive_cells"])]),
+            ("dalle_gateway_streams_active",
+             "Gateway flights with a live SSE/token stream",
+             [(None, stats["streams_active"])]),
             ("dalle_gateway_cell_inflight",
              "Gateway-tracked in-flight requests per cell",
              [({"cell": rec["cell"]}, rec["inflight"])
@@ -808,7 +942,8 @@ def make_gateway_http_server(gateway: Gateway, host: str = "127.0.0.1",
                 kwargs = {k: req[k] for k in
                           ("seed", "temperature", "filter_thres",
                            "top_p", "priority", "deadline_s",
-                           "cfg_scale") if k in req}
+                           "cfg_scale", "stream", "n_samples",
+                           "image_seq_len_override") if k in req}
                 handle = gateway.submit(
                     codes, api_key=auth.http_token(
                         self.headers, "X-API-Key"), **kwargs)
@@ -832,6 +967,10 @@ def make_gateway_http_server(gateway: Gateway, host: str = "127.0.0.1",
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": str(e)})
                 return
+            sink = getattr(handle, "sink", None)
+            if sink is not None:
+                self._stream_sse(handle, sink)
+                return
             try:
                 result = handle.result(timeout=request_timeout_s)
             except TimeoutError as e:
@@ -839,6 +978,34 @@ def make_gateway_http_server(gateway: Gateway, host: str = "127.0.0.1",
                 return
             self._send(_srv._HTTP_STATUS.get(result.status, 500),
                        _srv._result_body(result))
+
+        def _stream_sse(self, handle, sink) -> None:
+            """Same SSE contract as the cell server's facade (event
+            framing in docs/SERVING.md): a torn connection fulfils the
+            gateway handle cancelled, and the flight sweep cancels the
+            cell-side arm so the engine reaps its slots."""
+            from dalle_pytorch_tpu.serve import stream as stream_mod
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+            try:
+                for ev in sink.events(heartbeat_s=5.0):
+                    self.wfile.write(stream_mod.sse_bytes(ev))
+                    self.wfile.flush()
+                result = handle.result(timeout=request_timeout_s)
+                self.wfile.write(stream_mod.sse_bytes(
+                    {"event": "result", **_srv._result_body(result)}))
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                handle.fulfill(S.Result(
+                    status=S.CANCELLED,
+                    request_id=handle.request.request_id,
+                    reason="client disconnected mid-stream"))
+            except TimeoutError:
+                pass
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     httpd.daemon_threads = True

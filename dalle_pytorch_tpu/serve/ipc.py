@@ -82,7 +82,13 @@ from dalle_pytorch_tpu.serve.transport import IPCError  # noqa: F401
 #                       (re-export: the typed error every layer fences on)
 
 # v2: the header grew a per-connection frame sequence number, and the
-# handshake kinds (HELLO/HELLO_OK) joined for socket-transport attach
+# handshake kinds (HELLO/HELLO_OK) joined for socket-transport attach.
+# The header version pins the FRAME LAYOUT only; payload schema evolves
+# by field tolerance instead (Request/Result.from_wire `.get` defaults
+# — e.g. the streaming/fan-out fields stream/n_samples/
+# image_seq_len_override decode from a pre-streaming peer's frames as
+# their defaults), so a rolling upgrade can mix peers without a flag
+# day. Bump this ONLY when the header itself changes shape.
 PROTOCOL_VERSION = 2
 
 # frame kinds — parent -> child

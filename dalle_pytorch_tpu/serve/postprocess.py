@@ -54,6 +54,15 @@ class PostProcessor:
         # predate its own completion)
         self.on_fulfill = on_fulfill
         self.decoded = 0
+        # progressive previews (serve/stream.py): frames decoded from a
+        # zero-padded image-token prefix and pushed into the request's
+        # sink. preview_frames counts frames DELIVERED (including the
+        # final full-prefix frame); preview_drops counts requests shed
+        # because the pipeline queue was full — previews are strictly
+        # best-effort and must never backpressure the engine thread,
+        # unlike completions, which may.
+        self.preview_frames = 0
+        self.preview_drops = 0
 
         # bounded: a stalled consumer backpressures the engine thread at
         # submit() instead of growing an unbounded token backlog
@@ -93,7 +102,30 @@ class PostProcessor:
     # -- the engine's completion hook ---------------------------------------
 
     def submit(self, handle: S.RequestHandle, result: S.Result) -> None:
-        self._q.put((handle, result))
+        self._q.put(("result", handle, result))
+
+    def submit_preview(self, handle: S.RequestHandle, prefix) -> None:
+        """The engine's ``on_preview`` hook (called from the harvest
+        path every ``preview_every`` chunks): decode the image-token
+        prefix into a progressive frame. Non-blocking — a busy pipeline
+        drops the frame rather than stalling the engine; the stream
+        still ends with the final frame, which rides the completion."""
+        try:
+            self._q.put_nowait(("preview", handle, prefix))
+        except queue.Full:
+            self.preview_drops += 1
+
+    def _img_batch(self, tokens) -> np.ndarray:
+        """One [1, image_seq_len] int32 row, zero-padded past the given
+        tokens. EVERY decode — full result, short-grid override result,
+        mid-stream preview prefix — goes through this same fixed shape,
+        so the jitted VAE program compiles once and a preview's final
+        full-prefix frame is bit-identical to the completion's image."""
+        n = int(self.cfg.image_seq_len)
+        row = np.zeros((1, n), np.int32)
+        t = np.asarray(tokens, np.int32).reshape(-1)[:n]
+        row[0, :len(t)] = t
+        return row
 
     def pending(self) -> int:
         return self._q.qsize()
@@ -127,16 +159,37 @@ class PostProcessor:
 
     # -- worker -------------------------------------------------------------
 
+    def _preview(self, handle: S.RequestHandle, prefix) -> None:
+        """Decode one progressive frame and push it into the request's
+        sink. A terminal handle (cancelled mid-stream, already
+        fulfilled) skips the decode — the sink is closed anyway."""
+        import jax.numpy as jnp
+        sink = getattr(handle, "sink", None)
+        if sink is None or handle.done():
+            return
+        img_seq = jnp.asarray(self._img_batch(prefix))
+        image = self._decode(self.vae_params,
+                             self.params["image_emb"]["w"], img_seq)
+        sink.push_preview(int(np.asarray(prefix).size),
+                          np.asarray(image)[0])
+        self.preview_frames += 1
+
     def _work(self) -> None:
         import jax.numpy as jnp
         while not (self._stop.is_set() and self._q.empty()):
             try:
-                handle, result = self._q.get(timeout=0.05)
+                kind, handle, result = self._q.get(timeout=0.05)
             except queue.Empty:
+                continue
+            if kind == "preview":
+                try:
+                    self._preview(handle, result)   # result = prefix
+                except Exception:   # noqa: BLE001 — previews are
+                    pass            # best-effort, never a terminal path
                 continue
             t0 = time.perf_counter()
             try:
-                img_seq = jnp.asarray(result.tokens)[None]
+                img_seq = jnp.asarray(self._img_batch(result.tokens))
                 image = self._decode(self.vae_params,
                                      self.params["image_emb"]["w"], img_seq)
                 result.image = np.asarray(image)[0]
@@ -160,6 +213,15 @@ class PostProcessor:
                                         jnp.asarray(text), image)
                     result.clip_score = float(np.asarray(score)[0])
                 self.decoded += 1
+                sink = getattr(handle, "sink", None)
+                if sink is not None:
+                    # the stream's closing frame IS the result image —
+                    # same padded row, same jitted program as every
+                    # preview, so "final SSE frame == non-streamed
+                    # image" holds byte-for-byte by construction
+                    sink.push_preview(int(len(result.tokens)),
+                                      result.image, final=True)
+                    self.preview_frames += 1
                 result.total_s = round(
                     result.total_s + (time.perf_counter() - t0), 6)
                 self._trace_span(handle)

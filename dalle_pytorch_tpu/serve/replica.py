@@ -278,6 +278,7 @@ class ReplicaSet:
                  speculative: int = 0,
                  draft_layers: int = 0,
                  prefix_cache: bool = False,
+                 preview_every: int = 0,
                  clock: Callable[[], float] = time.perf_counter,
                  heartbeat_s: float = 5.0,
                  bringup_policy=None,
@@ -418,7 +419,14 @@ class ReplicaSet:
             kv=kv, page_size=page_size, num_pages=num_pages,
             paged_attn=paged_attn, sparse_reads=sparse_reads,
             speculative=speculative, draft_layers=draft_layers,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, preview_every=preview_every)
+        # progressive-preview hook (serve/stream.py): installed by the
+        # server AFTER construction, copied onto each replica engine at
+        # bring-up. Thread isolation only — a child-process engine has
+        # stand-in handles with no sink, so previews (like streaming)
+        # are a typed reject there, and _child_kwargs deliberately
+        # omits preview_every.
+        self.on_preview: Optional[Callable] = None
         self.worker_ckpt = worker_ckpt
         if self.isolation == "process":
             import numpy as np
@@ -684,6 +692,10 @@ class ReplicaSet:
                                     device=r.device,
                                     **{**self._engine_kwargs,
                                        **versioned})
+                # every bring-up (initial, restart, scale-out) inherits
+                # the set-level preview hook — a replica that replaced
+                # a crashed one keeps streaming previews
+                engine.on_preview = self.on_preview
         except Exception as e:  # noqa: BLE001 — circuit-break, don't die
             r.attempt += 1
             self.bringup_failures += 1

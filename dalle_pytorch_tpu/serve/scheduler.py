@@ -133,7 +133,20 @@ class Request:
     pays the pair; 0, the default, is off). ``tenant`` names the
     admitting tenant for weighted-fair queueing and per-tenant
     accounting — ``""`` (the default) is the anonymous tenant, which
-    keeps single-tenant deployments byte-identical to before."""
+    keeps single-tenant deployments byte-identical to before.
+
+    ``stream`` marks the request as a live token stream: the engine
+    pushes every harvested chunk into the handle's attached sink
+    (serve/stream.py) as it lands, in addition to the terminal Result.
+    ``n_samples > 1`` asks for a best-of-N sample GROUP — the serving
+    tier fans the prompt out into N member requests with per-sample
+    derived seeds (serve/fanout.py) and re-ranks the finished set by
+    CLIP score; the field rides the wire so a gateway/transport hop
+    can charge and route the whole group as one unit.
+    ``image_seq_len_override`` (0 = off) caps the generated image span
+    at fewer tokens than the model's full grid: decode stops once the
+    override span is sampled, a train-free short-grid draft that rides
+    the existing prefill buckets unchanged."""
     codes: Tuple[int, ...]
     seed: int = 0
     sampling: SamplingParams = SamplingParams()
@@ -141,6 +154,9 @@ class Request:
     deadline_s: Optional[float] = None   # relative to submit time
     cfg_scale: float = 0.0               # classifier-free guidance
     tenant: str = ""                     # admitting tenant (gateway)
+    stream: bool = False                 # live token sink wanted
+    n_samples: int = 1                   # best-of-N group size
+    image_seq_len_override: int = 0      # 0 = full grid
     request_id: int = -1                 # assigned by the queue
     submit_t: float = 0.0                # perf_counter, set by the queue
 
@@ -148,6 +164,12 @@ class Request:
         if self.cfg_scale < 0:
             raise ValueError(f"cfg_scale must be >= 0, got "
                              f"{self.cfg_scale}")
+        if self.n_samples < 1:
+            raise ValueError(f"n_samples must be >= 1, got "
+                             f"{self.n_samples}")
+        if self.image_seq_len_override < 0:
+            raise ValueError(f"image_seq_len_override must be >= 0, "
+                             f"got {self.image_seq_len_override}")
 
     @property
     def deadline_t(self) -> Optional[float]:
@@ -176,6 +198,9 @@ class Request:
                                 else max(self.deadline_t - now, 0.0)),
             "cfg_scale": float(self.cfg_scale),
             "tenant": str(self.tenant),
+            "stream": bool(self.stream),
+            "n_samples": int(self.n_samples),
+            "image_seq_len_override": int(self.image_seq_len_override),
         }
 
     @classmethod
@@ -199,6 +224,12 @@ class Request:
             cfg_scale=float(d.get("cfg_scale", 0.0)),
             # .get: pre-tenancy frames decode as the anonymous tenant
             tenant=str(d.get("tenant", "")),
+            # .get x3: pre-streaming frames decode as plain one-shot
+            # full-grid requests — the same tolerance rule as above
+            stream=bool(d.get("stream", False)),
+            n_samples=int(d.get("n_samples", 1)),
+            image_seq_len_override=int(
+                d.get("image_seq_len_override", 0)),
             request_id=int(d["id"]),
             submit_t=float(now))
 
@@ -233,6 +264,11 @@ class Result:
     # ride the result frame raw; the parent re-summarizes its merged
     # trace, so the summary always describes the CALLER's timeline)
     trace: Optional[dict] = None
+    # best-of-N group assembly (serve/fanout.py): the member Results
+    # ranked best-first by CLIP score. Parent-side only — members
+    # cross the wire individually; the group is re-assembled wherever
+    # the caller's GroupFuture lives, so this never ships in a frame
+    samples: Optional[list] = None
 
     @property
     def ok(self) -> bool:
@@ -324,6 +360,12 @@ class RequestHandle:
         # determinism (and the no-starvation argument) breaks
         self.vstart: Optional[float] = None
         self.vfinish: Optional[float] = None
+        # live token sink (serve/stream.py TokenSink), attached by the
+        # server when request.stream is set. None for everything else —
+        # the engine's harvest feeds it when present and never blocks
+        # on it. Parent-side only: a process-isolation stand-in handle
+        # has no sink, which is why streaming there is a typed reject.
+        self.sink = None
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -340,7 +382,17 @@ class RequestHandle:
                 result.trace = self.trace.summary()
             self._result = result
             self._done.set()
-            return True
+        # outside the lock: closing the stream sink can wake a consumer
+        # thread that immediately calls back into handle methods — and
+        # fulfill is the ONE terminal funnel, so every path (completion,
+        # postprocess, expiry, error, cancel) ends the stream exactly
+        # once. A sink failure must never lose the result itself.
+        if self.sink is not None:
+            try:
+                self.sink.close(result)
+            except Exception:
+                pass
+        return True
 
     def result(self, timeout: Optional[float] = None) -> Result:
         if not self._done.wait(timeout):
@@ -443,7 +495,12 @@ class RequestQueue:
             self.on_event(record)
         raise exc_type(record)
 
-    def submit(self, request: Request) -> RequestHandle:
+    def submit(self, request: Request, sink=None) -> RequestHandle:
+        """``sink`` (serve/stream.py TokenSink) must be attached HERE,
+        under the same lock that publishes the handle to the heap — an
+        attach after submit returns would race the engine thread, which
+        can pop, prefill, and harvest the first chunk before the caller
+        runs again, silently losing the stream's opening tokens."""
         now = self.clock()
         with self._lock:
             if self._closed:
@@ -469,6 +526,7 @@ class RequestQueue:
                                           submit_t=now)
             handle = RequestHandle(request)
             handle.queue_seq = next(self._seq)
+            handle.sink = sink
             # every submitted request is traced (obs/trace.py): the
             # zero-duration submit marker anchors the tiling timeline
             # at the exact instant the caller's latency clock starts

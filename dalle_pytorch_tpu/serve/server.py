@@ -58,6 +58,8 @@ class InferenceServer:
                  speculative: int = 0,
                  draft_layers: int = 0,
                  prefix_cache: bool = False,
+                 preview_every: int = 0,
+                 stream_max_events: int = 256,
                  default_cfg_scale: float = 0.0,
                  replicas: int = 1,
                  replica_roles=None,
@@ -181,7 +183,7 @@ class InferenceServer:
                 kv=kv, page_size=page_size, num_pages=num_pages,
                 paged_attn=paged_attn, sparse_reads=sparse_reads,
                 speculative=speculative, draft_layers=draft_layers,
-                prefix_cache=prefix_cache,
+                prefix_cache=prefix_cache, preview_every=preview_every,
                 heartbeat_s=heartbeat_s, isolation=isolation,
                 child_rss_limit_mb=child_rss_limit_mb,
                 transport=transport, worker_endpoint=worker_endpoint,
@@ -222,7 +224,7 @@ class InferenceServer:
                 kv=kv, page_size=page_size, num_pages=num_pages,
                 paged_attn=paged_attn, sparse_reads=sparse_reads,
                 speculative=speculative, draft_layers=draft_layers,
-                prefix_cache=prefix_cache,
+                prefix_cache=prefix_cache, preview_every=preview_every,
                 weights_version=self.weights_version,
                 model_version=self.weights_version)
         else:
@@ -234,7 +236,7 @@ class InferenceServer:
                 kv=kv, page_size=page_size, num_pages=num_pages,
                 paged_attn=paged_attn, sparse_reads=sparse_reads,
                 speculative=speculative, draft_layers=draft_layers,
-                prefix_cache=prefix_cache,
+                prefix_cache=prefix_cache, preview_every=preview_every,
                 weights_version=self.weights_version,
                 model_version=self.weights_version)
 
@@ -247,6 +249,29 @@ class InferenceServer:
                 params, vae_params, cfg, clip_params=clip_params,
                 clip_cfg=clip_cfg, metrics=self.engine.metrics,
                 on_fulfill=self._record_latency)
+
+        # -- streaming & fan-out plumbing (docs/SERVING.md 'Streaming,
+        # fan-out & variable resolution') --------------------------------
+        # progressive previews need somewhere to decode pixels: the
+        # engine's harvest-side hook hands the image-token prefix to the
+        # postprocess worker (best-effort, never blocking the engine)
+        self.stream_max_events = int(stream_max_events)
+        self.preview_every = int(preview_every)
+        if self.post is not None and preview_every:
+            self.engine.on_preview = self.post.submit_preview
+        # live registries swept lazily at stats() time: sinks whose
+        # channel hasn't ended (streams_active) and group futures not
+        # yet assembled (groups_in_flight). Completed paged+prefix
+        # groups credit fanout_pages_saved — the COW dividend: pages
+        # the siblings' prompt spans would have cost as N cold prefills
+        self._streams: list = []
+        self._groups: list = []
+        self._stream_lock = threading.Lock()
+        self.fanout_pages_saved = 0
+        self.groups_completed = 0
+        self._page_size = int(page_size) or (min(16, cfg.seq_len)
+                                             if kv == "paged" else 0)
+        self._cow_sharing = (kv == "paged" and prefix_cache)
 
         # /metrics exposition (obs/registry.py): the sliding-window
         # latency histograms, labeled per weights_version so a rolling
@@ -417,23 +442,94 @@ class InferenceServer:
                priority: int = 0,
                deadline_s: Optional[float] = None,
                cfg_scale: Optional[float] = None,
-               tenant: str = "") -> S.RequestHandle:
+               tenant: str = "",
+               stream: bool = False,
+               n_samples: int = 1,
+               image_seq_len_override: int = 0,
+               sinks: Optional[list] = None):
         """Enqueue one generation request. Raises a typed, structured
         ``scheduler.ServeRejected`` subclass: ``QueueFull`` on
         backpressure, ``InvalidRequest`` for an empty or over-long
         prompt, ``QueueClosed`` after ``close()``. ``cfg_scale``
         (default: the server's ``default_cfg_scale``) > 0 samples with
         classifier-free guidance — the engine runs a cond/uncond slot
-        pair for this request alone; no dedicated engine needed."""
+        pair for this request alone; no dedicated engine needed.
+
+        ``stream=True`` attaches a live ``TokenSink`` (the returned
+        handle's ``.sink``) fed every harvested chunk; ``n_samples>1``
+        admits a best-of-N group and returns a ``GroupFuture`` (handle-
+        compatible) whose result carries the CLIP-ranked sample set;
+        ``image_seq_len_override`` caps the generated grid for a
+        train-free short-resolution draft. All three compose.
+
+        ``sinks`` lets an upstream tier (the gateway) supply its own
+        pre-built TokenSinks — one per sample — instead of this server
+        creating fresh ones: a replayed dispatch then re-feeds the SAME
+        client-facing sinks, whose high-water marks dedupe the replay."""
         if cfg_scale is None:
             cfg_scale = self.default_cfg_scale
-        return self.queue.submit(S.Request(
+        if stream and self.isolation == "process":
+            # the child's stand-in handle has no sink — tokens live in
+            # another interpreter until the result frame lands, so a
+            # "stream" would be a lie. Typed refusal, not a silent
+            # downgrade to one-shot.
+            record = S.structured_event(
+                "serve_reject", reason="stream_process_isolation",
+                detail="token streaming requires isolation='thread' — "
+                       "a child-process engine's harvest loop cannot "
+                       "reach this process's sinks")
+            self._queue_event(record)
+            raise S.InvalidRequest(record)
+        request = S.Request(
             codes=tuple(int(c) for c in codes), seed=seed,
             sampling=S.SamplingParams(temperature=temperature,
                                       filter_thres=filter_thres,
                                       top_p=top_p),
             priority=priority, deadline_s=deadline_s,
-            cfg_scale=float(cfg_scale), tenant=str(tenant)))
+            cfg_scale=float(cfg_scale), tenant=str(tenant),
+            stream=bool(stream), n_samples=int(n_samples),
+            image_seq_len_override=int(image_seq_len_override))
+        if request.n_samples > 1:
+            from dalle_pytorch_tpu.serve import fanout
+            group = fanout.submit_group(
+                self.queue, request, metrics=self.metrics,
+                max_events=self.stream_max_events, sinks=sinks)
+            with self._stream_lock:
+                self._groups.append(group)
+                if group.sink is not None:
+                    self._streams.append(group.sink)
+            return group
+        sink = sinks[0] if sinks else None
+        if request.stream and sink is None:
+            from dalle_pytorch_tpu.serve.stream import TokenSink
+            sink = TokenSink(max_events=self.stream_max_events,
+                             metrics=self.metrics)
+        handle = self.queue.submit(request, sink=sink)
+        if sink is not None:
+            sink.request_id = handle.request.request_id
+            with self._stream_lock:
+                self._streams.append(sink)
+        return handle
+
+    def _sweep_streams(self) -> None:
+        """Retire finished streams/groups from the live registries and
+        bank each completed group's COW page dividend — called from
+        ``stats()`` so the gauges are current at every scrape without a
+        dedicated sweeper thread."""
+        from dalle_pytorch_tpu.serve import fanout
+        with self._stream_lock:
+            self._streams = [s for s in self._streams if not s.done]
+            still = []
+            for g in self._groups:
+                if not g.done():
+                    still.append(g)
+                    continue
+                self.groups_completed += 1
+                if self._cow_sharing:
+                    self.fanout_pages_saved += fanout.group_pages_saved(
+                        g.request.n_samples, len(g.request.codes),
+                        self._page_size)
+            self._groups = still
 
     def generate(self, codes, timeout: Optional[float] = None,
                  **kwargs) -> S.Result:
@@ -571,6 +667,20 @@ class InferenceServer:
             "postprocess_pending": (self.post.pending()
                                     if self.post is not None else 0),
         })
+        # streaming & fan-out surface (ISSUE 20 satellite): live gauges
+        # from the swept registries, lifetime counters from the stages
+        self._sweep_streams()
+        with self._stream_lock:
+            out.update({
+                "streams_active": len(self._streams),
+                "groups_in_flight": len(self._groups),
+                "groups_completed": self.groups_completed,
+                "fanout_pages_saved": self.fanout_pages_saved,
+            })
+        out["preview_frames"] = (self.post.preview_frames
+                                 if self.post is not None else 0)
+        out["preview_drops"] = (self.post.preview_drops
+                                if self.post is not None else 0)
         return out
 
     # -- /metrics (Prometheus text exposition) ------------------------------
@@ -621,6 +731,15 @@ class InferenceServer:
          "Tokens live migration avoided re-decoding"),
         ("profiles_taken", "dalle_serve_profiles_taken_total",
          "Completed POST /admin/profile captures"),
+        ("reaped", "dalle_serve_reaped_total",
+         "Slots freed because the handle terminated externally "
+         "(stream disconnect, group cancel, hedge loser)"),
+        ("preview_frames", "dalle_serve_preview_frames_total",
+         "Progressive preview frames decoded and delivered"),
+        ("groups_completed", "dalle_serve_groups_completed_total",
+         "Best-of-N sample groups assembled to a ranked result"),
+        ("fanout_pages_saved", "dalle_serve_fanout_pages_saved_total",
+         "KV pages COW prompt sharing saved across completed groups"),
     )
     _GAUGE_METRICS = (
         ("queue_depth", "dalle_serve_queue_depth",
@@ -649,6 +768,10 @@ class InferenceServer:
          "1 while a rolling upgrade owns the fleet"),
         ("profile_active", "dalle_serve_profile_active",
          "1 while a jax.profiler capture is in flight"),
+        ("streams_active", "dalle_serve_streams_active",
+         "SSE/token streams currently open (a group counts once)"),
+        ("groups_in_flight", "dalle_serve_groups_in_flight",
+         "Best-of-N sample groups still decoding"),
     )
 
     def metrics_text(self) -> str:
@@ -801,6 +924,11 @@ def _result_body(result: S.Result) -> dict:
         body["image_shape"] = list(result.image.shape)
     if result.clip_score is not None:
         body["clip_score"] = result.clip_score
+    if result.samples is not None:
+        # best-of-N: the ranked member set, best first — the top-level
+        # fields above already describe the winner, so a caller that
+        # ignores this key still gets best-of-N semantics for free
+        body["samples"] = [_result_body(r) for r in result.samples]
     return body
 
 
@@ -941,7 +1069,9 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     raise ValueError("need non-empty 'codes' or 'caption'")
                 kwargs = {k: req[k] for k in
                           ("seed", "temperature", "filter_thres", "top_p",
-                           "priority", "deadline_s", "cfg_scale")
+                           "priority", "deadline_s", "cfg_scale",
+                           "stream", "n_samples",
+                           "image_seq_len_override")
                           if k in req}
                 handle = server.submit(codes, **kwargs)
             except S.InvalidRequest as e:
@@ -956,6 +1086,10 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": str(e)})
                 return
+            sink = getattr(handle, "sink", None)
+            if sink is not None:
+                self._stream_sse(handle, sink)
+                return
             try:
                 result = handle.result(timeout=request_timeout_s)
             except TimeoutError as e:
@@ -963,6 +1097,41 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 return
             self._send(_HTTP_STATUS.get(result.status, 500),
                        _result_body(result))
+
+        def _stream_sse(self, handle, sink) -> None:
+            """The streaming response: server-sent events over a
+            chunkless HTTP/1.1 body (no Content-Length; the connection
+            close delimits the stream — EventSource-compatible).
+            Heartbeat comments keep idle proxies from timing the
+            stream out; a torn connection (client gone) cancels the
+            request/group on the spot, so the engine's done-handle
+            reap frees its slots and pages instead of decoding into
+            the void."""
+            from dalle_pytorch_tpu.serve import stream as stream_mod
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+            try:
+                for ev in sink.events(heartbeat_s=5.0):
+                    self.wfile.write(stream_mod.sse_bytes(ev))
+                    self.wfile.flush()
+                # the terminal frame: the assembled result (ranked
+                # samples for a group), so an SSE client needs no
+                # second round-trip to fetch what it just watched
+                result = handle.result(timeout=request_timeout_s)
+                self.wfile.write(stream_mod.sse_bytes(
+                    {"event": "result", **_result_body(result)}))
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                handle.fulfill(S.Result(
+                    status=S.CANCELLED,
+                    request_id=handle.request.request_id,
+                    reason="client disconnected mid-stream"))
+            except TimeoutError:
+                pass    # stream already delivered everything it had
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     httpd.daemon_threads = True

@@ -645,6 +645,116 @@ class TestGateway:
             gw.close()
 
 
+class TestStreamingFanoutGateway:
+    """Fan-out and streams through the fleet door (ISSUE 20): the WFQ
+    charges decoded work (n_samples x the per-sample span), tenant page
+    budgets charge the COW footprint (one prompt span + N generation
+    spans, not N cold prefills), hedging is a typed reject for live
+    streams, and a streamed best-of-N round trip feeds the
+    gateway-owned sinks end to end."""
+
+    def test_wfq_charges_n_samples_times_span(self, bundle):
+        # fairness stays decoded-work-denominated under fan-out: a
+        # best-of-3 advances the finish tag by 3x the span, and a
+        # short-grid override charges its shorter span — neither
+        # splitting nor shrinking work can game the share
+        _, _, cfg = bundle
+        gw = _gateway(bundle, n_cells=1)
+        try:
+            h = gw.submit((1, 2), seed=0, n_samples=3)
+            assert h.vfinish - h.vstart == pytest.approx(
+                3.0 * cfg.image_seq_len)
+            h2 = gw.submit((1, 3), seed=0, n_samples=2,
+                           image_seq_len_override=8)
+            assert h2.vfinish - h2.vstart == pytest.approx(2.0 * 8)
+            assert h.result(120).ok and h2.result(120).ok
+        finally:
+            gw.close()
+
+    def test_tenant_pages_charge_cow_footprint(self, bundle):
+        # the page reservation models the COW group: tiny cfg has
+        # text=8 + image=16 = 24 positions, base 6 pages per request.
+        # best-of-4 shares ONE prompt span: (8 + 4*16)/24 * 6 = 18
+        # pages — strictly under the 24 four cold prefills would cost
+        gw = _gateway(bundle, n_cells=1)
+        try:
+            base = gw.pages_per_request
+            assert gw._flight_pages(1, 0) == base == 6
+            assert gw._flight_pages(4, 0) == 18 < 4 * base
+            # a short-grid override shrinks the generation share
+            assert gw._flight_pages(4, 8) == 10
+            assert gw._flight_pages(1, 8) == 4 < base
+            # without a cfg the geometry is unknown: conservative N x
+            saved = gw.cfg
+            gw.cfg = None
+            try:
+                assert gw._flight_pages(4, 0) == 4 * base
+            finally:
+                gw.cfg = saved
+        finally:
+            gw.close()
+
+    def test_hedge_is_typed_reject_for_streams(self, bundle):
+        # hedge_s=0 would hedge every dispatch — but two live arms
+        # would both feed the client's sinks. The stream keeps its
+        # single arm; the refusal is a typed event + counter, and the
+        # request still completes OK
+        tbl = T.TenantTable.from_json(
+            [{"name": "gold", "key": "kg", "tier": "gold",
+              "hedge_s": 0.0}])
+        gw = _gateway(bundle, tenants=tbl, hedge_check_s=0.0)
+        try:
+            h = gw.submit((4, 2, 1), api_key="kg", seed=3,
+                          stream=True)
+            assert h.result(120).ok
+            assert gw.hedge_stream_rejects >= 1
+            evs = gw.events("gateway_hedge_reject")
+            assert evs and evs[0]["reason"] == "stream"
+            assert not gw.events("gateway_hedge")
+            assert gw.stats()["hedge_stream_rejects"] >= 1
+            assert "dalle_gateway_hedge_stream_rejects_total" \
+                in gw.metrics_text()
+        finally:
+            gw.close()
+
+    def test_streamed_best_of_n_end_to_end(self, bundle):
+        # gateway-owned sinks (replay-safe) deliver both samples'
+        # token events and group-atomic sample_done frames; the
+        # flight's terminal returns the COW page reservation and the
+        # streams_active gauge drains back to zero
+        _, _, cfg = bundle
+        tbl = T.TenantTable.from_json(
+            [{"name": "acme", "key": "k", "max_pages": 64}])
+        gw = _gateway(bundle, n_cells=1, tenants=tbl)
+        try:
+            h = gw.submit((2, 3, 4), api_key="k", seed=9,
+                          stream=True, n_samples=2)
+            sink = gw._flights[h.request.request_id].sinks[0]
+            assert sink.replayable
+            seen, done_samples = {}, []
+            for ev in sink.events():
+                if ev["event"] == "tokens":
+                    seen.setdefault(ev["sample"], {})[ev["pos"]] \
+                        = ev["tokens"]
+                elif ev["event"] == "sample_done":
+                    done_samples.append(ev["sample"])
+            res = h.result(120)
+            assert res.ok and len(res.tokens) == cfg.image_seq_len
+            assert sorted(done_samples) == [0, 1]
+            for s in (0, 1):
+                toks = []
+                for pos in sorted(seen[s]):
+                    toks.extend(seen[s][pos])
+                assert len(toks) >= cfg.image_seq_len
+            assert tbl.stats()["acme"]["pages_in_flight"] == 0
+            st = gw.stats()
+            assert st["streams_active"] == 0 and st["completed"] >= 1
+            assert "dalle_gateway_streams_active" \
+                in gw.metrics_text()
+        finally:
+            gw.close()
+
+
 class TestCellStatsSurface:
     def test_replica_set_aggregates_prefix_stats(self, bundle):
         # the cell-stats satellite: a ReplicaSet-backed cell exposes

@@ -355,6 +355,58 @@ class TestWireRoundTrip:
         with pytest.raises(ValueError, match="status"):
             S.Result.from_wire(wire)
 
+    def test_streaming_fields_round_trip(self):
+        """The streaming/fan-out schema additions ship over the wire:
+        stream, n_samples, image_seq_len_override survive a framed
+        round trip exactly (a child-process engine must see the same
+        short-grid budget the parent admitted)."""
+        req = S.Request(codes=(1, 2, 3), seed=9, stream=True,
+                        n_samples=1, image_seq_len_override=8,
+                        request_id=5, submit_t=10.0)
+        h = S.RequestHandle(req)
+        h.queue_seq = 1
+        _, payload, _ = ipc.decode_frame(ipc.encode_frame(
+            ipc.ADMIT, {"requests": [h.to_wire(now=10.0)]}))
+        r2 = S.RequestHandle.from_wire(payload["requests"][0],
+                                       now=10.0).request
+        assert r2.stream is True
+        assert r2.n_samples == 1
+        assert r2.image_seq_len_override == 8
+
+    def test_legacy_frame_without_streaming_fields_decodes(self):
+        """Version tolerance (the PR-14 idiom): a frame encoded by a
+        pre-streaming peer — same header version, payload simply
+        missing the new fields — decodes as a plain one-shot request
+        with the defaults, not a KeyError. The header version pins the
+        FRAME layout; payload schema evolves by field tolerance."""
+        req = S.Request(codes=(4, 5), seed=3, request_id=8,
+                        submit_t=20.0)
+        h = S.RequestHandle(req)
+        h.queue_seq = 2
+        wire = h.to_wire(now=20.0)
+        for k in ("stream", "n_samples", "image_seq_len_override"):
+            assert k in wire        # the new encoder ships them...
+            del wire[k]             # ...a legacy encoder did not
+        _, payload, _ = ipc.decode_frame(ipc.encode_frame(
+            ipc.ADMIT, {"requests": [wire]}))
+        r2 = S.RequestHandle.from_wire(payload["requests"][0],
+                                       now=20.0).request
+        assert r2.stream is False
+        assert r2.n_samples == 1
+        assert r2.image_seq_len_override == 0
+        assert r2.codes == req.codes and r2.seed == req.seed
+
+    def test_result_samples_stay_parent_side(self):
+        """A group's ranked ``samples`` list never crosses the IPC
+        boundary: members ship as ordinary results and the parent
+        assembles the group — so a legacy child needs no schema
+        change. The encoder must therefore not emit the field."""
+        res = S.Result(status=S.OK, request_id=1,
+                       samples=[S.Result(status=S.OK, request_id=2)])
+        wire = res.to_wire()
+        assert "samples" not in wire
+        assert S.Result.from_wire(wire).samples is None
+
 
 # ---------------------------------------------------------------------------
 # the client's poisoned-not-deadlocked contract (no process needed)

@@ -1,0 +1,292 @@
+"""Streaming tier tests (serve/stream.py + the engine's sink feed).
+
+Two layers, matching the module's trust model:
+
+  * the CHANNEL is exact without a backend: absolute-position replay
+    dedupe (eviction/failover replay delivers every position at most
+    once), the typed drop-oldest overflow policy (the engine never
+    blocks, the gap is named, terminals survive any backlog),
+    group-atomic close countdown, heartbeat synthesis, SSE wire
+    framing, and bit-exact image packing — all jax-free unit tests;
+  * the ENGINE feed preserves identity: a streamed request's token
+    events, reassembled by position, are byte-identical to the same
+    seed's non-streamed result (streaming moves observation, never
+    computation); a torn SSE connection mid-stream cancels the request
+    and the engine's done-handle reap frees its slot AND its KV pages;
+    a slow consumer costs dropped events (typed), never engine
+    progress and never a truncated terminal result.
+
+Tiny model (test_serve's 24-position config), all CPU, tier-1 cheap.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.serve import scheduler as S
+from dalle_pytorch_tpu.serve import stream as st
+from dalle_pytorch_tpu.serve.stream import TokenSink
+
+# ---------------------------------------------------------------------------
+# channel semantics (no jax)
+# ---------------------------------------------------------------------------
+
+
+class TestSinkBasics:
+    def test_events_in_order_and_tagged(self):
+        sink = TokenSink(request_id=9)
+        sink.push_tokens(0, [1, 2])
+        sink.push_tokens(2, [3])
+        sink.close(S.Result(status=S.OK, request_id=9,
+                            tokens=np.asarray([1, 2, 3])))
+        evs = list(sink.events())
+        assert [e["event"] for e in evs] == ["tokens", "tokens",
+                                            "sample_done"]
+        assert evs[0]["pos"] == 0 and evs[0]["tokens"] == [1, 2]
+        assert evs[1]["pos"] == 2 and evs[1]["tokens"] == [3]
+        assert all(e["request_id"] == 9 for e in evs)
+        assert evs[-1]["status"] == S.OK and evs[-1]["n_tokens"] == 3
+        assert sink.done
+
+    def test_replay_duplicate_prefix_dropped(self):
+        """Failover replay re-pushes from position zero; the high-water
+        mark delivers every position exactly once."""
+        sink = TokenSink()
+        sink.push_tokens(0, [1, 2, 3])
+        sink.push_tokens(0, [1, 2, 3])          # full replay duplicate
+        sink.push_tokens(1, [2, 3, 4, 5])       # overlapping: only 4,5 new
+        sink.push_tokens(3, [4, 5])             # already delivered
+        got = []
+        while (ev := sink.get(timeout=0)) is not None:
+            got.append((ev["pos"], ev["tokens"]))
+        assert got == [(0, [1, 2, 3]), (3, [4, 5])]
+
+    def test_push_after_close_is_dropped(self):
+        sink = TokenSink()
+        sink.close(S.Result(status=S.OK, request_id=0))
+        sink.push_tokens(0, [1])
+        evs = list(sink.events())
+        assert [e["event"] for e in evs] == ["sample_done"]
+
+    def test_close_is_idempotent_first_wins(self):
+        sink = TokenSink()
+        sink.close(S.Result(status=S.OK, request_id=0))
+        sink.close(S.Result(status=S.ERROR, request_id=0, reason="late"))
+        evs = list(sink.events())
+        assert len(evs) == 1 and evs[0]["status"] == S.OK
+        assert sink.result.status == S.OK
+
+    def test_replayable_ignores_nonforced_cancel(self):
+        """A gateway-owned sink survives the cell-side failover cancel:
+        only the owner's forced close (or a genuine completion) is
+        terminal."""
+        sink = TokenSink()
+        sink.replayable = True
+        sink.close(S.Result(status=S.CANCELLED, request_id=0,
+                            reason="cell died"))
+        assert not sink.closed
+        sink.push_tokens(0, [1])                # replay still lands
+        sink.close(S.Result(status=S.OK, request_id=0), force=True)
+        assert sink.closed and sink.result.status == S.OK
+
+
+class TestOverflow:
+    def test_slow_consumer_typed_not_blocking(self):
+        """A consumer that never reads: pushes past the ring shed the
+        OLDEST droppable event and return immediately; the next read is
+        prefixed with a synthetic overflow event naming the gap; the
+        terminal still lands."""
+        sink = TokenSink(max_events=4)
+        t0 = time.perf_counter()
+        for i in range(20):
+            sink.push_tokens(i, [i])
+        assert time.perf_counter() - t0 < 0.5   # never blocked
+        sink.close(S.Result(status=S.OK, request_id=0))
+        evs = list(sink.events())
+        assert evs[0]["event"] == "overflow"
+        # 16 shed by the push storm + 1 more when the terminal claimed
+        # its slot in the full ring
+        assert evs[0]["dropped"] == 17
+        assert evs[0]["total_dropped"] == sink.dropped == 17
+        # the oldest were shed: the survivors are the NEWEST positions
+        poss = [e["pos"] for e in evs if e["event"] == "tokens"]
+        assert poss == [17, 18, 19]
+        assert evs[-1]["event"] == "sample_done"
+
+    def test_terminal_never_dropped(self):
+        sink = TokenSink(max_events=4)
+        for i in range(10):
+            sink.push_tokens(i, [i])
+        sink.close(S.Result(status=S.OK, request_id=0))
+        for i in range(10, 20):                 # after close: dropped
+            sink.push_tokens(i, [i])
+        kinds = [e["event"] for e in sink.events()]
+        assert kinds.count("sample_done") == 1
+
+    def test_min_ring_size_enforced(self):
+        with pytest.raises(ValueError, match="max_events"):
+            TokenSink(max_events=2)
+
+
+class TestGroupChannel:
+    def test_group_atomic_close(self):
+        """N sinks over one channel: events carry their sample tag and
+        the multiplexed stream ends only after ALL members close."""
+        sinks = TokenSink.group(3)
+        sinks[1].push_tokens(0, [7])
+        sinks[0].close(S.Result(status=S.OK, request_id=0))
+        sinks[2].close(S.Result(status=S.OK, request_id=2))
+        assert not sinks[0].done                # member 1 still live
+        sinks[1].close(S.Result(status=S.ERROR, request_id=1,
+                                reason="boom"))
+        evs = list(sinks[0].events())
+        assert [e["event"] for e in evs] == [
+            "tokens", "sample_done", "sample_done", "sample_done"]
+        assert evs[0]["sample"] == 1
+        assert sorted(e["sample"] for e in evs[1:]) == [0, 1, 2]
+        assert all(s.done for s in sinks)
+
+    def test_heartbeat_synthesized_when_quiet(self):
+        sink = TokenSink()
+
+        def close_late():
+            time.sleep(0.12)
+            sink.close(S.Result(status=S.OK, request_id=0))
+
+        t = threading.Thread(target=close_late)
+        t.start()
+        kinds = [e["event"] for e in sink.events(heartbeat_s=0.03)]
+        t.join()
+        assert "heartbeat" in kinds
+        assert kinds[-1] == "sample_done"
+
+
+class TestWireForms:
+    def test_sse_framing(self):
+        b = st.sse_bytes({"event": "tokens", "pos": 3, "tokens": [1]})
+        assert b.startswith(b"event: tokens\ndata: ")
+        assert b.endswith(b"\n\n")
+        import json
+        payload = json.loads(
+            b.split(b"data: ", 1)[1].strip().decode())
+        assert payload == {"pos": 3, "tokens": [1]}
+
+    def test_pack_unpack_image_bit_exact(self):
+        rng = np.random.default_rng(0)
+        for dtype in (np.float32, np.uint8):
+            img = rng.standard_normal((4, 4, 3)).astype(dtype)
+            out = st.unpack_image(st.pack_image(img))
+            assert out.dtype == img.dtype and out.shape == img.shape
+            np.testing.assert_array_equal(out, img)
+
+
+# ---------------------------------------------------------------------------
+# the engine feed (tiny model)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    import jax
+
+    from dalle_pytorch_tpu.models import dalle as D
+    from dalle_pytorch_tpu.models import vae as V
+
+    vcfg = V.VAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                       num_layers=2, hidden_dim=8)
+    cfg = D.DALLEConfig(dim=16, depth=2, vae=vcfg, num_text_tokens=50,
+                        text_seq_len=8, heads=2, dim_head=8)
+    key = jax.random.PRNGKey(0)
+    vae_params = V.vae_init(jax.random.fold_in(key, 1), vcfg)
+    params = D.dalle_init(key, cfg, vae_params)
+    return params, cfg
+
+
+def _engine(params, cfg, **kw):
+    from dalle_pytorch_tpu.serve import RequestQueue
+    from dalle_pytorch_tpu.serve.engine import Engine
+
+    queue = RequestQueue(max_depth=16)
+    return Engine(params, cfg, queue, num_slots=2, chunk_steps=4,
+                  **kw), queue
+
+
+class TestEngineFeed:
+    def test_streamed_tokens_byte_identical_to_result(self, bundle):
+        """THE identity: reassemble the sink's token events by absolute
+        position — the suffix of length len(result.tokens) must equal
+        the terminal result byte-for-byte, and that result must equal
+        the same request run WITHOUT a sink (streaming is observation
+        only)."""
+        params, cfg = bundle
+        engine, queue = _engine(params, cfg)
+        req = S.Request(codes=(3, 7, 9), seed=11, stream=True)
+        sink = TokenSink()
+        h = queue.submit(req, sink=sink)
+        engine.run_until_idle()
+        res = h.result(timeout=30)
+        assert res.status == S.OK
+        by_pos = {}
+        for ev in sink.events():
+            if ev["event"] == "tokens":
+                by_pos[ev["pos"]] = ev["tokens"]
+        toks = []
+        for pos in sorted(by_pos):
+            toks.extend(by_pos[pos])
+        np.testing.assert_array_equal(
+            np.asarray(toks[-len(res.tokens):], np.int32),
+            np.asarray(res.tokens))
+        # and the terminal sample_done rode the fulfill funnel
+        assert sink.result is res
+
+        plain = queue.submit(S.Request(codes=(3, 7, 9), seed=11))
+        engine.run_until_idle()
+        np.testing.assert_array_equal(
+            np.asarray(plain.result(timeout=30).tokens),
+            np.asarray(res.tokens))
+
+    def test_torn_connection_cancels_and_frees_pages(self, bundle):
+        """The SSE writer's disconnect path fulfils CANCELLED
+        mid-stream; the engine's done-handle reap must kill the slot
+        and return every KV page — no generation into the void, no
+        leaked pages."""
+        params, cfg = bundle
+        engine, queue = _engine(params, cfg, kv="paged", page_size=8)
+        sink = TokenSink()
+        h = queue.submit(S.Request(codes=(3, 7, 9), seed=11,
+                                   stream=True), sink=sink)
+        # drive until the stream is genuinely live (first chunk landed)
+        deadline = time.perf_counter() + 30
+        while sink.get(timeout=0) is None:
+            engine.step_once()
+            assert time.perf_counter() < deadline
+        assert engine.alloc.in_use > 0
+        # the disconnect: exactly what Handler._stream_sse does
+        h.fulfill(S.Result(status=S.CANCELLED,
+                           request_id=h.request.request_id,
+                           reason="client disconnected mid-stream"))
+        engine.run_until_idle()
+        assert engine.reaped >= 1
+        assert engine.alloc.in_use == 0, "cancel must free the KV pages"
+        assert sink.closed and sink.result.status == S.CANCELLED
+        # the channel ended cleanly for the (gone) consumer too
+        assert list(sink.events())[-1]["event"] == "sample_done"
+
+    def test_slow_consumer_overflow_result_still_complete(self, bundle):
+        """A tiny ring and a consumer that reads nothing until the end:
+        the engine completes normally, the overflow is typed, and the
+        terminal result still carries the COMPLETE token sequence."""
+        params, cfg = bundle
+        engine, queue = _engine(params, cfg)
+        sink = TokenSink(max_events=4)
+        h = queue.submit(S.Request(codes=(6, 6), seed=5, stream=True),
+                         sink=sink)
+        engine.run_until_idle()
+        res = h.result(timeout=30)
+        assert res.status == S.OK
+        assert len(res.tokens) == cfg.image_seq_len
+        evs = list(sink.events())
+        assert evs[0]["event"] == "overflow" and sink.dropped > 0
+        assert evs[-1]["event"] == "sample_done"
